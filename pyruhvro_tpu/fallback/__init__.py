@@ -1,0 +1,13 @@
+from .io import MalformedAvro
+from .decoder import decode_to_record_batch, decode_records, compile_reader
+from .encoder import encode_record_batch, compile_writer, extract_rows
+
+__all__ = [
+    "MalformedAvro",
+    "decode_to_record_batch",
+    "decode_records",
+    "compile_reader",
+    "encode_record_batch",
+    "compile_writer",
+    "extract_rows",
+]

@@ -1,0 +1,360 @@
+"""General-path Avro → Arrow decoder (host, pure Python).
+
+This is the analogue of the reference's ``Value``-tree baseline path
+(``ruhvro/src/deserialize.rs:34-48`` + ``ruhvro/src/complex.rs``): it
+covers the FULL Avro type surface (everything ``schema_translate.rs``
+maps), serves as the runtime fallback for schemas outside the fast
+subset, and — most importantly — is the **differential-test oracle** the
+TPU fast path is validated against, exactly as the reference's fast
+decoder is asserted equal to its baseline decoder
+(``fast_decode.rs:945-953``).
+
+Two stages, mirroring the reference:
+1. per-datum wire decode into a Python value tree
+   (≙ ``apache_avro::from_avro_datum`` → ``Value``), via per-schema
+   compiled reader closures;
+2. value-tree → Arrow builders (≙ ``complex.rs`` ``AvroToArrowBuilder``),
+   finished into a ``pyarrow.RecordBatch``.
+
+Value-tree conventions: null→None, record→dict, array→list,
+map→list[(key, value)], union→(branch_index, value), enum→symbol str,
+decimal→unscaled int.
+"""
+
+from __future__ import annotations
+
+import decimal
+import uuid as _uuid
+from typing import Callable, List, Sequence
+
+import pyarrow as pa
+
+from ..schema.model import (
+    Array,
+    AvroType,
+    Enum,
+    Fixed,
+    Map,
+    Primitive,
+    Record,
+    Union,
+)
+from ..schema.arrow_map import to_arrow_field, to_arrow_schema
+from .io import (
+    MalformedAvro,
+    read_bool,
+    read_bytes,
+    read_double,
+    read_float,
+    read_long,
+)
+
+__all__ = ["compile_reader", "decode_records", "ValuesToArrow", "MalformedAvro"]
+
+
+# ---------------------------------------------------------------------------
+# Stage 1: wire bytes → value tree
+# ---------------------------------------------------------------------------
+
+def compile_reader(t: AvroType) -> Callable:
+    """Build a ``reader(buf, pos) -> (value, pos)`` closure for ``t``."""
+    if isinstance(t, Primitive):
+        name = t.name
+        if name == "null":
+            return lambda buf, pos: (None, pos)
+        if name == "boolean":
+            return read_bool
+        if name in ("int", "long"):
+            if t.logical == "decimal":  # bytes-decimal handled under bytes
+                raise NotImplementedError
+            return read_long
+        if name == "float":
+            return read_float
+        if name == "double":
+            return read_double
+        if name == "bytes":
+            if t.logical == "decimal":
+                def read_decimal(buf, pos):
+                    raw, pos = read_bytes(buf, pos)
+                    return int.from_bytes(raw, "big", signed=True), pos
+                return read_decimal
+            return read_bytes
+        if name == "string":
+            def read_string(buf, pos):
+                raw, pos = read_bytes(buf, pos)
+                try:
+                    return raw.decode("utf-8"), pos
+                except UnicodeDecodeError as e:
+                    raise MalformedAvro(f"invalid UTF-8 in string: {e}") from None
+            return read_string
+        raise NotImplementedError(name)
+
+    if isinstance(t, Fixed):
+        size = t.size
+        if t.logical == "decimal":
+            def read_fixed_decimal(buf, pos):
+                if pos + size > len(buf):
+                    raise MalformedAvro("truncated fixed")
+                return (
+                    int.from_bytes(buf[pos : pos + size], "big", signed=True),
+                    pos + size,
+                )
+            return read_fixed_decimal
+
+        def read_fixed(buf, pos):
+            if pos + size > len(buf):
+                raise MalformedAvro("truncated fixed")
+            return bytes(buf[pos : pos + size]), pos + size
+        return read_fixed
+
+    if isinstance(t, Enum):
+        symbols = t.symbols
+        n = len(symbols)
+        def read_enum(buf, pos):
+            idx, pos = read_long(buf, pos)
+            if not 0 <= idx < n:
+                raise MalformedAvro(f"enum index {idx} out of range 0..{n}")
+            return symbols[idx], pos
+        return read_enum
+
+    if isinstance(t, Array):
+        item_reader = compile_reader(t.items)
+        def read_array(buf, pos):
+            out = []
+            while True:
+                count, pos = read_long(buf, pos)
+                if count == 0:
+                    return out, pos
+                if count < 0:
+                    # negative block count: abs(count) items preceded by a
+                    # byte-size long we can skip over (fast_decode.rs:689-700)
+                    count = -count
+                    _, pos = read_long(buf, pos)
+                for _ in range(count):
+                    v, pos = item_reader(buf, pos)
+                    out.append(v)
+        return read_array
+
+    if isinstance(t, Map):
+        value_reader = compile_reader(t.values)
+        def read_map(buf, pos):
+            out = []
+            while True:
+                count, pos = read_long(buf, pos)
+                if count == 0:
+                    return out, pos
+                if count < 0:
+                    count = -count
+                    _, pos = read_long(buf, pos)
+                for _ in range(count):
+                    raw, pos = read_bytes(buf, pos)
+                    k = raw.decode("utf-8")
+                    v, pos = value_reader(buf, pos)
+                    out.append((k, v))
+        return read_map
+
+    if isinstance(t, Union):
+        readers = tuple(compile_reader(v) for v in t.variants)
+        n = len(readers)
+        def read_union(buf, pos):
+            idx, pos = read_long(buf, pos)
+            if not 0 <= idx < n:
+                raise MalformedAvro(f"union branch {idx} out of range 0..{n}")
+            v, pos = readers[idx](buf, pos)
+            return (idx, v), pos
+        return read_union
+
+    if isinstance(t, Record):
+        field_readers = tuple((f.name, compile_reader(f.type)) for f in t.fields)
+        def read_record(buf, pos):
+            row = {}
+            for name, rd in field_readers:
+                row[name], pos = rd(buf, pos)
+            return row, pos
+        return read_record
+
+    raise NotImplementedError(f"no reader for {t!r}")
+
+
+def decode_records(data: Sequence[bytes], t: AvroType) -> List[object]:
+    """Decode each datum fully; trailing bytes are an error."""
+    reader = compile_reader(t)
+    out = []
+    for datum in data:
+        value, pos = reader(datum, 0)
+        if pos != len(datum):
+            raise MalformedAvro(
+                f"trailing bytes after datum: consumed {pos} of {len(datum)}"
+            )
+        out.append(value)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Stage 2: value trees → Arrow arrays
+# ---------------------------------------------------------------------------
+
+class ValuesToArrow:
+    """Assemble Arrow arrays from value trees for one Avro type
+    (≙ ``complex.rs`` builders, but batch-at-once instead of row-at-a-time;
+    the row-at-a-time protocol is ``append``/``finish``)."""
+
+    def __init__(self, t: AvroType, field: pa.Field):
+        self.t = t
+        self.field = field
+
+    def build(self, values: List[object]) -> pa.Array:
+        return _build_array(self.t, self.field.type, values)
+
+
+def _build_array(t: AvroType, dt: pa.DataType, values: List[object]) -> pa.Array:
+    # unwrap nullable-pair unions: values are (branch, v) tuples
+    if isinstance(t, Union) and t.is_nullable_pair:
+        null_idx = t.null_index
+        inner = [None if v is None or v[0] == null_idx else v[1] for v in values]
+        return _build_array(t.non_null_variant, dt, inner)
+
+    if isinstance(t, Primitive):
+        if t.logical == "decimal":
+            ctx = decimal.Context(prec=max(t.precision, 1))
+            scale = t.scale
+            vals = [
+                None
+                if v is None
+                else ctx.create_decimal(v).scaleb(-scale, ctx)
+                for v in values
+            ]
+            return pa.array(vals, type=dt)
+        if t.logical == "uuid":
+            vals = [
+                None if v is None else _uuid.UUID(v).bytes for v in values
+            ]
+            return pa.array(vals, type=dt)
+        return pa.array(values, type=dt)
+
+    if isinstance(t, Fixed):
+        if t.logical == "decimal":
+            ctx = decimal.Context(prec=max(t.precision, 1))
+            scale = t.scale
+            vals = [
+                None
+                if v is None
+                else ctx.create_decimal(v).scaleb(-scale, ctx)
+                for v in values
+            ]
+            return pa.array(vals, type=dt)
+        if t.logical == "duration":
+            # avro duration fixed(12) = (months, days, millis) little-endian
+            # u32; reference maps to Duration(ms). Months/days have no exact
+            # ms length; we use the Arrow convention 1 day = 86_400_000 ms,
+            # 1 month = 30 days, documenting the reference's lossy mapping.
+            def to_ms(v):
+                if v is None:
+                    return None
+                months = int.from_bytes(v[0:4], "little")
+                days = int.from_bytes(v[4:8], "little")
+                ms = int.from_bytes(v[8:12], "little")
+                return ((months * 30 + days) * 86_400_000) + ms
+            return pa.array([to_ms(v) for v in values], type=dt)
+        return pa.array(values, type=dt)
+
+    if isinstance(t, Enum):
+        return pa.array(values, type=pa.string())
+
+    if isinstance(t, Array):
+        item_field = dt.value_field
+        offsets = [0]
+        child_values = []
+        n = 0
+        for v in values:
+            if v is None:
+                offsets.append(None)
+            else:
+                child_values.extend(v)
+                n += len(v)
+                offsets.append(n)
+        child = _build_array(t.items, item_field.type, child_values)
+        return pa.ListArray.from_arrays(
+            pa.array(offsets, pa.int32()), child, type=dt
+        )
+
+    if isinstance(t, Map):
+        offsets = [0]
+        keys: List[object] = []
+        vals: List[object] = []
+        n = 0
+        for v in values:
+            if v is None:
+                offsets.append(None)
+            else:
+                for k, item in v:
+                    keys.append(k)
+                    vals.append(item)
+                n += len(v)
+                offsets.append(n)
+        key_arr = pa.array(keys, pa.string())
+        val_arr = _build_array(t.values, dt.item_type, vals)
+        return pa.MapArray.from_arrays(
+            pa.array(offsets, pa.int32()), key_arr, val_arr, type=dt
+        )
+
+    if isinstance(t, Union):
+        # sparse union: one child per variant, same length; non-selected
+        # rows are null in every child (fast_decode.rs:643-668)
+        n_var = len(t.variants)
+        type_ids = []
+        per_child: List[List[object]] = [[] for _ in range(n_var)]
+        for v in values:
+            idx, inner = (0, None) if v is None else v
+            type_ids.append(idx)
+            for c in range(n_var):
+                per_child[c].append(inner if c == idx else None)
+        children = []
+        field_names = []
+        for c, (vt, child_field) in enumerate(zip(t.variants, dt)):
+            children.append(_build_array(vt, child_field.type, per_child[c]))
+            field_names.append(child_field.name)
+        return pa.UnionArray.from_sparse(
+            pa.array(type_ids, pa.int8()),
+            children,
+            field_names=field_names,
+            type_codes=list(dt.type_codes),
+        )
+
+    if isinstance(t, Record):
+        validity = [v is not None for v in values]
+        any_null = not all(validity)
+        children = []
+        fields = []
+        for i, f in enumerate(t.fields):
+            child_field = dt.field(i)
+            child_vals = [None if v is None else v[f.name] for v in values]
+            children.append(_build_array(f.type, child_field.type, child_vals))
+            fields.append(child_field)
+        mask = pa.array([not v for v in validity]) if any_null else None
+        return pa.StructArray.from_arrays(children, fields=fields, mask=mask)
+
+    raise NotImplementedError(f"no builder for {t!r}")
+
+
+def decode_to_record_batch(
+    data: Sequence[bytes], t: AvroType, arrow_schema: pa.Schema = None
+) -> pa.RecordBatch:
+    """Full fallback decode: ``list[bytes]`` → ``pa.RecordBatch``
+    (≙ ``per_datum_deserialize_baseline``, ``deserialize.rs:34-48``)."""
+    if not isinstance(t, Record):
+        raise ValueError("top-level Avro schema must be a record")
+    if arrow_schema is None:
+        arrow_schema = to_arrow_schema(t)
+    rows = decode_records(data, t)
+    if not t.fields:
+        # zero-column batch must still carry the row count
+        return pa.RecordBatch.from_struct_array(
+            pa.array([{}] * len(rows), pa.struct([]))
+        )
+    arrays = []
+    for i, f in enumerate(t.fields):
+        field = arrow_schema.field(i)
+        col_vals = [row[f.name] for row in rows]
+        arrays.append(_build_array(f.type, field.type, col_vals))
+    return pa.RecordBatch.from_arrays(arrays, schema=arrow_schema)

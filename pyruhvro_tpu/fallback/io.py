@@ -1,0 +1,152 @@
+"""Avro binary wire-format primitives (host, pure Python).
+
+The byte-level readers/writers mirror the reference's
+``fast_decode.rs:846-922`` (``read_zigzag_long``, ``read_f32/f64``,
+``read_bool``, ``read_string``) and ``fast_encode.rs:586-599``
+(``write_zigzag_long``, ``write_string``), with the same malformed-input
+policy: bounds are checked and a ``ValueError`` is raised rather than
+panicking.
+
+Avro spec recap (wire format):
+* int/long: little-endian base-128 varint of the zig-zag encoding
+* float/double: 4/8 bytes IEEE-754 little-endian
+* boolean: one byte 0/1
+* bytes/string: length (long) then payload
+* fixed: exactly N bytes
+"""
+
+from __future__ import annotations
+
+import struct
+
+__all__ = [
+    "MalformedAvro",
+    "read_varint",
+    "read_long",
+    "read_float",
+    "read_double",
+    "read_bool",
+    "read_bytes",
+    "zigzag_encode",
+    "zigzag_decode",
+    "write_long",
+    "write_float",
+    "write_double",
+    "write_bool",
+    "write_bytes",
+    "long_size",
+]
+
+_unpack_f32 = struct.Struct("<f").unpack_from
+_unpack_f64 = struct.Struct("<d").unpack_from
+_pack_f32 = struct.Struct("<f").pack
+_pack_f64 = struct.Struct("<d").pack
+
+
+class MalformedAvro(ValueError):
+    """Raised on truncated or invalid Avro wire bytes."""
+
+
+def zigzag_decode(n: int) -> int:
+    return (n >> 1) ^ -(n & 1)
+
+
+def zigzag_encode(n: int) -> int:
+    return (n << 1) ^ (n >> 63) if n < 0 else (n << 1)
+
+
+def read_varint(buf, pos: int):
+    """Read an unsigned base-128 varint; returns (value, new_pos)."""
+    acc = 0
+    shift = 0
+    n = len(buf)
+    while True:
+        if pos >= n:
+            raise MalformedAvro("truncated varint")
+        b = buf[pos]
+        pos += 1
+        acc |= (b & 0x7F) << shift
+        if not (b & 0x80):
+            return acc, pos
+        shift += 7
+        if shift > 63:
+            raise MalformedAvro("varint too long (max 10 bytes)")
+
+
+def read_long(buf, pos: int):
+    """Read a zig-zag varint long; returns (value, new_pos)
+    (≙ ``read_zigzag_long``, ``fast_decode.rs:855-869``)."""
+    acc, pos = read_varint(buf, pos)
+    # wrap to signed 64-bit like the reference's u64→i64 cast
+    acc &= (1 << 64) - 1
+    value = (acc >> 1) ^ -(acc & 1)
+    if value >= 1 << 63:
+        value -= 1 << 64
+    elif value < -(1 << 63):
+        value += 1 << 64
+    return value, pos
+
+
+def read_float(buf, pos: int):
+    if pos + 4 > len(buf):
+        raise MalformedAvro("truncated float")
+    return _unpack_f32(buf, pos)[0], pos + 4
+
+
+def read_double(buf, pos: int):
+    if pos + 8 > len(buf):
+        raise MalformedAvro("truncated double")
+    return _unpack_f64(buf, pos)[0], pos + 8
+
+
+def read_bool(buf, pos: int):
+    if pos >= len(buf):
+        raise MalformedAvro("truncated bool")
+    b = buf[pos]
+    if b > 1:
+        raise MalformedAvro(f"invalid bool byte {b:#x}")
+    return b == 1, pos + 1
+
+
+def read_bytes(buf, pos: int):
+    ln, pos = read_long(buf, pos)
+    if ln < 0:
+        raise MalformedAvro(f"negative bytes/string length {ln}")
+    if pos + ln > len(buf):
+        raise MalformedAvro("truncated bytes/string payload")
+    return bytes(buf[pos : pos + ln]), pos + ln
+
+
+def long_size(value: int) -> int:
+    """Number of wire bytes of a zig-zag varint for ``value``."""
+    z = zigzag_encode(value)
+    size = 1
+    while z >= 0x80:
+        z >>= 7
+        size += 1
+    return size
+
+
+def write_long(out: bytearray, value: int) -> None:
+    z = zigzag_encode(value) & ((1 << 64) - 1)
+    while z >= 0x80:
+        out.append((z & 0x7F) | 0x80)
+        z >>= 7
+    out.append(z)
+
+
+def write_float(out: bytearray, value: float) -> None:
+    out += _pack_f32(value)
+
+
+def write_double(out: bytearray, value: float) -> None:
+    out += _pack_f64(value)
+
+
+def write_bool(out: bytearray, value: bool) -> None:
+    out.append(1 if value else 0)
+
+
+def write_bytes(out: bytearray, value) -> None:
+    write_long(out, len(value))
+    out += value

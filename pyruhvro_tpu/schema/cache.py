@@ -1,0 +1,80 @@
+"""Process-global schema cache.
+
+≙ the reference's ``schema_cache``/``get_or_parse_schema``
+(``src/lib.rs:35-54``): a mutex-guarded map keyed by the *raw schema
+string*, unbounded by design — callers are expected to pass a small number
+of distinct schema strings over a process lifetime. We additionally hang
+the translated Arrow schema and (lazily) the compiled TPU field program
+off the same entry, which is the "schema → compiled kernel cache" the
+TPU design calls for (SURVEY.md §2, shared-schema amortization row).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional
+
+import pyarrow as pa
+
+from .arrow_map import to_arrow_schema
+from .model import AvroType
+from .parser import parse_schema
+
+__all__ = ["SchemaEntry", "get_or_parse_schema", "clear_schema_cache"]
+
+
+class SchemaEntry:
+    """Everything derived from one schema string, computed once."""
+
+    __slots__ = ("schema_str", "ir", "_arrow", "_lock", "_extras")
+
+    def __init__(self, schema_str: str, ir: AvroType):
+        self.schema_str = schema_str
+        self.ir = ir
+        self._arrow: Optional[pa.Schema] = None
+        self._lock = threading.Lock()
+        self._extras: Dict[str, object] = {}
+
+    @property
+    def arrow_schema(self) -> pa.Schema:
+        if self._arrow is None:
+            with self._lock:
+                if self._arrow is None:
+                    self._arrow = to_arrow_schema(self.ir)
+        return self._arrow
+
+    def get_extra(self, key: str, factory):
+        """Lazily build & memoize per-schema derived objects (decoders,
+        encoders, lowered field programs, jitted kernels)."""
+        try:
+            return self._extras[key]
+        except KeyError:
+            pass
+        with self._lock:
+            if key not in self._extras:
+                self._extras[key] = factory()
+            return self._extras[key]
+
+
+_cache: Dict[str, SchemaEntry] = {}
+_cache_lock = threading.Lock()
+
+
+def get_or_parse_schema(schema_str: str) -> SchemaEntry:
+    """Return the cached entry for this exact schema string, parsing on
+    first sight (double-checked, like ``src/lib.rs:44-54``)."""
+    entry = _cache.get(schema_str)
+    if entry is not None:
+        return entry
+    ir = parse_schema(schema_str)  # parse outside the lock; parsing is pure
+    with _cache_lock:
+        entry = _cache.get(schema_str)
+        if entry is None:
+            entry = SchemaEntry(schema_str, ir)
+            _cache[schema_str] = entry
+        return entry
+
+
+def clear_schema_cache() -> None:
+    with _cache_lock:
+        _cache.clear()

@@ -1,0 +1,190 @@
+"""Random test-data generation for any Avro schema.
+
+Plays the role of the reference's use of ``apache_avro::types::Record`` +
+``to_avro_datum`` to generate test input (``fast_decode.rs:935-943``) and
+of ``scripts/generate_avro.py``'s Faker-based Kafka workload (no Faker in
+this environment; we synthesize comparable strings from word lists).
+
+``random_value`` produces value trees in the fallback codec's convention
+(record→dict, map→list[(k,v)], union→(branch, value)), which
+``encode_value`` turns into wire bytes via the fallback encoder.
+"""
+
+from __future__ import annotations
+
+import random
+import string as _string
+from typing import List
+
+from ..schema.model import (
+    Array,
+    AvroType,
+    Enum,
+    Fixed,
+    Map,
+    Primitive,
+    Record,
+    Union,
+)
+from ..fallback.encoder import compile_writer
+
+__all__ = ["random_value", "random_datums", "kafka_style_datums", "KAFKA_SCHEMA_JSON"]
+
+_WORDS = (
+    "alpha bravo charlie delta echo foxtrot golf hotel india juliett kilo lima "
+    "mike november oscar papa quebec romeo sierra tango uniform victor whiskey "
+    "xray yankee zulu amber birch cedar dune ember flint grove harbor inlet"
+).split()
+
+
+def _word(rng) -> str:
+    return rng.choice(_WORDS)
+
+
+def _text(rng, lo=0, hi=24) -> str:
+    n = rng.randint(lo, hi)
+    return "".join(rng.choice(_string.ascii_letters + _string.digits + " _@.")
+                   for _ in range(n))
+
+
+def random_value(t: AvroType, rng: random.Random, depth: int = 0):
+    if isinstance(t, Primitive):
+        name = t.name
+        if name == "null":
+            return None
+        if name == "boolean":
+            return rng.random() < 0.5
+        if name == "int":
+            if t.logical is not None:
+                return rng.randint(0, 20_000)
+            return rng.randint(-(2**31), 2**31 - 1)
+        if name == "long":
+            if t.logical is not None:
+                return rng.randint(0, 2**41)
+            return rng.randint(-(2**63), 2**63 - 1)
+        if name == "float":
+            # keep float32-representable to make round trips exact
+            import struct
+            v = rng.uniform(-1e6, 1e6)
+            return struct.unpack("<f", struct.pack("<f", v))[0]
+        if name == "double":
+            return rng.uniform(-1e12, 1e12)
+        if name == "bytes":
+            if t.logical == "decimal":
+                return rng.randint(-(10**t.precision) + 1, 10**t.precision - 1)
+            return bytes(rng.getrandbits(8) for _ in range(rng.randint(0, 16)))
+        if name == "string":
+            if t.logical == "uuid":
+                import uuid
+                return str(uuid.UUID(int=rng.getrandbits(128)))
+            return _text(rng)
+        raise NotImplementedError(name)
+    if isinstance(t, Fixed):
+        if t.logical == "decimal":
+            return rng.randint(-(10**t.precision) + 1, 10**t.precision - 1)
+        return bytes(rng.getrandbits(8) for _ in range(t.size))
+    if isinstance(t, Enum):
+        return rng.choice(t.symbols)
+    if isinstance(t, Array):
+        n = rng.randint(0, 4 if depth < 2 else 1)
+        return [random_value(t.items, rng, depth + 1) for _ in range(n)]
+    if isinstance(t, Map):
+        n = rng.randint(0, 4 if depth < 2 else 1)
+        # distinct keys: Avro maps are logically string→value
+        keys = rng.sample(_WORDS, n)
+        return [(k, random_value(t.values, rng, depth + 1)) for k in keys]
+    if isinstance(t, Union):
+        idx = rng.randrange(len(t.variants))
+        return (idx, random_value(t.variants[idx], rng, depth + 1))
+    if isinstance(t, Record):
+        return {f.name: random_value(f.type, rng, depth + 1) for f in t.fields}
+    raise NotImplementedError(repr(t))
+
+
+def random_datums(t: AvroType, n: int, seed: int = 0) -> List[bytes]:
+    """n random wire-encoded datums of schema ``t``."""
+    rng = random.Random(seed)
+    writer = compile_writer(t)
+    out = []
+    for _ in range(n):
+        buf = bytearray()
+        writer(buf, random_value(t, rng))
+        out.append(bytes(buf))
+    return out
+
+
+KAFKA_SCHEMA_JSON = """\
+{
+  "type": "record",
+  "name": "User",
+  "fields": [
+    {"name": "name", "type": ["null", "string"], "default": null},
+    {"name": "age", "type": ["null", "int"], "default": null},
+    {"name": "emails", "type": {"type": "array", "items": "string"}},
+    {"name": "address", "type": ["null", {
+      "type": "record", "name": "Address",
+      "fields": [
+        {"name": "street", "type": "string"},
+        {"name": "city", "type": "string"},
+        {"name": "zipcode", "type": "string"}
+      ]}], "default": null},
+    {"name": "phone_numbers", "type": {"type": "map", "values": "string"}},
+    {"name": "preferences", "type": ["null", {
+      "type": "record", "name": "Preferences",
+      "fields": [
+        {"name": "contact_method", "type": ["null", "string"], "default": null},
+        {"name": "newsletter", "type": "boolean"}
+      ]}], "default": null},
+    {"name": "status", "type": ["null", "string", "int", "boolean"], "default": null},
+    {"name": "created_at", "type": "long"},
+    {"name": "class", "type": {"type": "enum", "name": "enum_col",
+                               "symbols": ["A", "B", "C"]}}
+  ]
+}
+"""
+
+
+def kafka_style_datums(n: int, seed: int = 0) -> List[bytes]:
+    """Workload equivalent to ``scripts/generate_avro.py`` (Faker-free):
+    same 9-field Kafka-style schema, realistic-ish field distributions
+    (``generate_avro.py:44-63``)."""
+    from ..schema.parser import parse_schema
+
+    t = parse_schema(KAFKA_SCHEMA_JSON)
+    rng = random.Random(seed)
+    writer = compile_writer(t)
+    out = []
+    for _ in range(n):
+        rec = {
+            "name": (1, f"{_word(rng).title()} {_word(rng).title()}")
+                    if rng.random() < 0.5 else None,
+            "age": (1, rng.randint(18, 80)) if rng.random() < 0.5 else None,
+            "emails": [f"{_word(rng)}{rng.randint(0,99)}@example.com"
+                       for _ in range(rng.randint(0, 3))],
+            "address": (1, {
+                "street": f"{rng.randint(1,9999)} {_word(rng).title()} St",
+                "city": _word(rng).title(),
+                "zipcode": f"{rng.randint(10000,99999)}",
+            }) if rng.random() < 0.5 else None,
+            "phone_numbers": [
+                (k, f"+1-{rng.randint(200,999)}-{rng.randint(1000,9999)}")
+                for k in rng.sample(_WORDS, rng.randint(0, 3))
+            ],
+            "preferences": (1, {
+                "contact_method": (1, rng.choice(["email", "phone"]))
+                                  if rng.random() < 0.67 else None,
+                "newsletter": rng.random() < 0.5,
+            }) if rng.random() < 0.5 else None,
+            "status": rng.choice([
+                (0, None),
+                (1, _word(rng)),
+                (2, rng.randint(0, 100)),
+                (3, rng.random() < 0.5),
+            ]),
+            "created_at": rng.randint(1_600_000_000, 1_800_000_000),
+            "class": rng.choice(["A", "B", "C"]),
+        }
+        buf = bytearray()
+        writer(buf, rec)
+        out.append(bytes(buf))
+    return out

@@ -1,0 +1,15 @@
+"""Test config: force JAX onto a virtual 8-device CPU mesh.
+
+Multi-chip configs are tested on CPU via device-count spoofing
+(SURVEY.md §4.7): real-TPU behavior is exercised by the driver's bench
+run, not by unit tests. Must run before the first `import jax` anywhere.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()

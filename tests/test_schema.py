@@ -1,0 +1,195 @@
+"""Schema parsing + Avro→Arrow translation tests
+(≙ ``schema_translate.rs`` tests at :290-341)."""
+
+import json
+
+import pyarrow as pa
+import pytest
+
+from pyruhvro_tpu.schema import (
+    Array,
+    Enum,
+    Map,
+    Primitive,
+    Record,
+    SchemaParseError,
+    Union,
+    get_or_parse_schema,
+    parse_schema,
+    to_arrow_schema,
+)
+
+KAFKA_SCHEMA = json.dumps({
+    "type": "record",
+    "name": "User",
+    "fields": [
+        {"name": "name", "type": ["null", "string"], "default": None},
+        {"name": "age", "type": ["null", "int"], "default": None},
+        {"name": "emails", "type": {"type": "array", "items": "string"}},
+        {"name": "address", "type": ["null", {
+            "type": "record", "name": "Address",
+            "fields": [
+                {"name": "street", "type": "string"},
+                {"name": "city", "type": "string"},
+                {"name": "zipcode", "type": "string"},
+            ]}], "default": None},
+        {"name": "phone_numbers", "type": {"type": "map", "values": "string"}},
+        {"name": "preferences", "type": ["null", {
+            "type": "record", "name": "Preferences",
+            "fields": [
+                {"name": "contact_method", "type": ["null", "string"], "default": None},
+                {"name": "newsletter", "type": "boolean"},
+            ]}], "default": None},
+        {"name": "status", "type": ["null", "string", "int", "boolean"], "default": None},
+        {"name": "created_at", "type": "long"},
+        {"name": "class", "type": {"type": "enum", "name": "enum_col",
+                                   "symbols": ["A", "B", "C"]}},
+    ],
+})
+
+
+def test_parse_primitives():
+    rec = parse_schema(json.dumps({
+        "type": "record", "name": "R",
+        "fields": [{"name": n, "type": n_t} for n, n_t in [
+            ("a", "int"), ("b", "long"), ("c", "float"), ("d", "double"),
+            ("e", "boolean"), ("f", "string"), ("g", "bytes"), ("h", "null"),
+        ]],
+    }))
+    assert isinstance(rec, Record)
+    assert [f.type for f in rec.fields] == [
+        Primitive("int"), Primitive("long"), Primitive("float"),
+        Primitive("double"), Primitive("boolean"), Primitive("string"),
+        Primitive("bytes"), Primitive("null"),
+    ]
+
+
+def test_parse_kafka_schema_shapes():
+    rec = parse_schema(KAFKA_SCHEMA)
+    assert isinstance(rec, Record) and rec.fullname == "User"
+    by_name = {f.name: f.type for f in rec.fields}
+    assert isinstance(by_name["name"], Union) and by_name["name"].is_nullable_pair
+    assert isinstance(by_name["emails"], Array)
+    assert isinstance(by_name["phone_numbers"], Map)
+    status = by_name["status"]
+    assert isinstance(status, Union) and len(status.variants) == 4
+    assert not status.is_nullable_pair and status.null_index == 0
+    assert isinstance(by_name["class"], Enum)
+    assert by_name["class"].symbols == ("A", "B", "C")
+
+
+def test_parse_named_ref():
+    # named-type reference reuse — beyond the reference impl (todo!() there)
+    rec = parse_schema(json.dumps({
+        "type": "record", "name": "R",
+        "fields": [
+            {"name": "a", "type": {"type": "record", "name": "Inner",
+                                   "fields": [{"name": "x", "type": "int"}]}},
+            {"name": "b", "type": "Inner"},
+        ],
+    }))
+    assert rec.fields[0].type is rec.fields[1].type
+
+
+def test_parse_recursive_rejected():
+    with pytest.raises(SchemaParseError, match="recursive"):
+        parse_schema(json.dumps({
+            "type": "record", "name": "Node",
+            "fields": [{"name": "next", "type": ["null", "Node"]}],
+        }))
+
+
+def test_parse_errors():
+    with pytest.raises(SchemaParseError):
+        parse_schema("not json at all {{{")
+    with pytest.raises(SchemaParseError):
+        parse_schema(json.dumps(["null", "null"]))  # duplicate null variants
+    with pytest.raises(SchemaParseError):
+        parse_schema(json.dumps({"type": "enum", "name": "E",
+                                 "symbols": ["A", "A"]}))
+    with pytest.raises(SchemaParseError):
+        parse_schema(json.dumps({"type": "array"}))  # missing items
+
+
+def test_arrow_mapping_kafka():
+    """Field names follow Avro names; nullable-pair unions collapse;
+    N-variant unions become sparse unions with type_ids 0..N."""
+    rec = parse_schema(KAFKA_SCHEMA)
+    schema = to_arrow_schema(rec)
+    assert schema.names == [
+        "name", "age", "emails", "address", "phone_numbers",
+        "preferences", "status", "created_at", "class",
+    ]
+    assert schema.field("name").type == pa.string()
+    assert schema.field("name").nullable
+    assert schema.field("age").type == pa.int32()
+    assert schema.field("emails").type == pa.list_(
+        pa.field("item", pa.string(), nullable=True))
+    addr = schema.field("address")
+    assert addr.nullable and pa.types.is_struct(addr.type)
+    assert [f.name for f in addr.type] == ["street", "city", "zipcode"]
+    # reference quirk: nested fields inherit parent nullability
+    assert all(f.nullable for f in addr.type)
+    pn = schema.field("phone_numbers").type
+    assert pa.types.is_map(pn)
+    assert pn.key_field.name == "keys" and pn.item_field.name == "values"
+    status = schema.field("status")
+    assert status.nullable
+    assert pa.types.is_union(status.type)
+    assert status.type.mode == "sparse"
+    assert [status.type.field(i).name for i in range(4)] == [
+        "null", "varchar", "int", "bit"]
+    assert list(status.type.type_codes) == [0, 1, 2, 3]
+    assert schema.field("created_at").type == pa.int64()
+    assert not schema.field("created_at").nullable
+    assert schema.field("class").type == pa.string()
+
+
+def test_arrow_mapping_logical_types():
+    rec = parse_schema(json.dumps({
+        "type": "record", "name": "L",
+        "fields": [
+            {"name": "d", "type": {"type": "int", "logicalType": "date"}},
+            {"name": "tm", "type": {"type": "int", "logicalType": "time-millis"}},
+            {"name": "tu", "type": {"type": "long", "logicalType": "time-micros"}},
+            {"name": "tsm", "type": {"type": "long", "logicalType": "timestamp-millis"}},
+            {"name": "tsu", "type": {"type": "long", "logicalType": "timestamp-micros"}},
+            {"name": "dec", "type": {"type": "bytes", "logicalType": "decimal",
+                                     "precision": 10, "scale": 2}},
+            {"name": "u", "type": {"type": "string", "logicalType": "uuid"}},
+            {"name": "fx", "type": {"type": "fixed", "name": "F8", "size": 8}},
+        ],
+    }))
+    schema = to_arrow_schema(rec)
+    assert schema.field("d").type == pa.date32()
+    assert schema.field("tm").type == pa.time32("ms")
+    assert schema.field("tu").type == pa.time64("us")
+    assert schema.field("tsm").type == pa.timestamp("ms")
+    assert schema.field("tsu").type == pa.timestamp("us")
+    assert schema.field("dec").type == pa.decimal128(10, 2)
+    assert schema.field("u").type == pa.binary(16)
+    assert schema.field("fx").type == pa.binary(8)
+
+
+def test_doc_metadata_preserved():
+    rec = parse_schema(json.dumps({
+        "type": "record", "name": "R",
+        "fields": [
+            {"name": "a", "doc": "field doc", "type": {
+                "type": "record", "name": "Inner", "doc": "type doc",
+                "fields": [{"name": "x", "type": "int", "doc": "inner field doc"}],
+            }},
+        ],
+    }))
+    schema = to_arrow_schema(rec)
+    # top-level fields carry the named type's doc (external_props)
+    assert schema.field("a").metadata[b"avro::doc"] == b"type doc"
+    # nested record fields carry the field's doc
+    assert schema.field("a").type.field("x").metadata[b"avro::doc"] == b"inner field doc"
+
+
+def test_schema_cache_identity():
+    e1 = get_or_parse_schema(KAFKA_SCHEMA)
+    e2 = get_or_parse_schema(KAFKA_SCHEMA)
+    assert e1 is e2
+    assert e1.arrow_schema is e2.arrow_schema
