@@ -1,0 +1,40 @@
+"""pyruhvro_tpu — TPU-native Avro ⇄ Arrow conversion.
+
+A from-scratch, TPU-first framework with the capabilities of
+Tyler-Sch/pyruhvro: fast, parallel conversion of schemaless Avro-encoded
+byte records into Apache Arrow RecordBatches and back.
+
+Where the reference walks bytes with per-record CPU threads
+(Rust/tokio), this package lowers the parsed Avro schema once into a
+vectorized byte-FSM kernel (JAX/XLA/Pallas) that decodes an entire batch
+of records in lockstep on a TPU, plus a symmetric vectorized encoder;
+out-of-subset schemas silently use a general host path, gated exactly
+where the reference gates (``deserialize.rs:26-29``).
+
+Public API matches the reference's 5 functions (``src/lib.rs:150-158``)
+with an extra ``backend=`` knob ("auto" | "tpu" | "host").
+"""
+
+from .api import (
+    deserialize_array,
+    deserialize_array_threaded,
+    deserialize_array_threaded_spawn,
+    serialize_record_batch,
+    serialize_record_batch_spawn,
+)
+from .gate import is_supported
+from .schema import parse_schema, to_arrow_schema
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "deserialize_array",
+    "deserialize_array_threaded",
+    "deserialize_array_threaded_spawn",
+    "serialize_record_batch",
+    "serialize_record_batch_spawn",
+    "is_supported",
+    "parse_schema",
+    "to_arrow_schema",
+    "__version__",
+]

@@ -1,0 +1,157 @@
+"""Public API — drop-in parity with the reference's 5 functions.
+
+≙ ``src/lib.rs:150-158``:
+
+* ``deserialize_array(data, schema)`` → one ``pyarrow.RecordBatch``
+* ``deserialize_array_threaded(data, schema, num_chunks)`` → ``list[RecordBatch]``
+  (one per chunk, never concatenated — ``deserialize.rs:76-121``)
+* ``deserialize_array_threaded_spawn`` — same result; the reference's
+  spawn variant differs only in host thread-pool strategy
+  (``src/lib.rs:108-128``), which has no analogue on the device path;
+  kept for signature parity.
+* ``serialize_record_batch(batch, schema, num_chunks)`` → ``list[BinaryArray]``
+* ``serialize_record_batch_spawn`` — ditto.
+
+One addition over the reference (the BASELINE.json north star):
+``backend=`` on every function — ``"auto"`` (default; TPU when the schema
+is in the fast subset and a device is present, matching the silent
+fast/fallback gate at ``deserialize.rs:26-29``), ``"tpu"`` (force device;
+errors if unsupported), ``"host"`` (force the general path).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import pyarrow as pa
+
+from .gate import is_supported
+from .fallback.decoder import decode_to_record_batch
+from .fallback.encoder import encode_record_batch
+from .runtime.chunking import chunk_bounds
+from .runtime.pool import map_chunks
+from .schema.cache import SchemaEntry, get_or_parse_schema
+
+__all__ = [
+    "deserialize_array",
+    "deserialize_array_threaded",
+    "deserialize_array_threaded_spawn",
+    "serialize_record_batch",
+    "serialize_record_batch_spawn",
+]
+
+
+def _device_codec(entry: SchemaEntry, backend: str):
+    """Resolve the TPU codec for this schema, or None for the host path.
+
+    backend="auto": device if the schema passes the fast gate AND a JAX
+    device backend initializes; silently falls back otherwise (reference
+    semantics). backend="tpu": device or raise. backend="host": None.
+    """
+    if backend == "host":
+        return None
+    supported = is_supported(entry.ir)
+    if backend == "auto" and not supported:
+        return None
+    if not supported:  # backend == "tpu"
+        raise ValueError(
+            "schema is outside the TPU fast-path subset "
+            "(bytes/fixed/decimal/uuid/duration/time-* fall back to host); "
+            "use backend='auto' or backend='host'"
+        )
+    try:
+        from .ops.codec import get_device_codec
+    except ImportError as e:
+        if backend == "tpu":
+            raise RuntimeError(
+                f"TPU backend is not available in this build: {e}"
+            ) from e
+        return None
+    try:
+        return get_device_codec(entry)
+    except Exception:
+        if backend == "tpu":
+            raise
+        return None
+
+
+def _check_backend(backend: str) -> str:
+    if backend not in ("auto", "tpu", "host"):
+        raise ValueError(f"backend must be 'auto', 'tpu' or 'host', got {backend!r}")
+    return backend
+
+
+def deserialize_array(
+    data: Sequence[bytes], schema: str, *, backend: str = "auto"
+) -> pa.RecordBatch:
+    """Decode Avro datums into a single RecordBatch
+    (≙ ``deserialize_array``, ``src/lib.rs:56-71``)."""
+    _check_backend(backend)
+    entry = get_or_parse_schema(schema)
+    codec = _device_codec(entry, backend)
+    if codec is not None:
+        return codec.decode(data)
+    return decode_to_record_batch(data, entry.ir, entry.arrow_schema)
+
+
+def deserialize_array_threaded(
+    data: Sequence[bytes], schema: str, num_chunks: int, *, backend: str = "auto"
+) -> List[pa.RecordBatch]:
+    """Decode in ``num_chunks`` chunks → one RecordBatch per chunk
+    (≙ ``deserialize_array_threaded``, ``src/lib.rs:73-89``).
+
+    On the device path, chunking shapes only the returned batch
+    boundaries — the whole input is decoded in one gridded launch
+    (the chunk axis maps to the device grid, not host threads)."""
+    _check_backend(backend)
+    entry = get_or_parse_schema(schema)
+    bounds = chunk_bounds(len(data), num_chunks)
+    codec = _device_codec(entry, backend)
+    if codec is not None:
+        batch = codec.decode(data)
+        return [batch.slice(a, b - a) for a, b in bounds]
+    ir, arrow = entry.ir, entry.arrow_schema
+    return map_chunks(
+        lambda ab: decode_to_record_batch(data[ab[0]:ab[1]], ir, arrow), bounds
+    )
+
+
+def deserialize_array_threaded_spawn(
+    data: Sequence[bytes], schema: str, num_chunks: int, *, backend: str = "auto"
+) -> List[pa.RecordBatch]:
+    """Signature-parity alias of :func:`deserialize_array_threaded`
+    (≙ ``src/lib.rs:108-128``; thread-pool flavor is a host-side detail)."""
+    return deserialize_array_threaded(data, schema, num_chunks, backend=backend)
+
+
+def serialize_record_batch(
+    batch: pa.RecordBatch, schema: str, num_chunks: int, *, backend: str = "auto"
+) -> List[pa.Array]:
+    """Encode a RecordBatch into Avro datums, one BinaryArray per chunk
+    (≙ ``serialize_record_batch``, ``src/lib.rs:91-106``)."""
+    _check_backend(backend)
+    entry = get_or_parse_schema(schema)
+    if isinstance(batch, pa.Table):
+        batches = batch.combine_chunks().to_batches()
+        batch = (
+            batches[0]
+            if batches
+            else pa.RecordBatch.from_pylist([], schema=batch.schema)
+        )
+    bounds = chunk_bounds(batch.num_rows, num_chunks)
+    codec = _device_codec(entry, backend)
+    if codec is not None:
+        return [codec.encode(batch.slice(a, b - a)) for a, b in bounds]
+    ir = entry.ir
+    def encode_chunk(ab):
+        datums = encode_record_batch(batch.slice(ab[0], ab[1] - ab[0]), ir)
+        return pa.array(datums, pa.binary())
+    return map_chunks(encode_chunk, bounds)
+
+
+def serialize_record_batch_spawn(
+    batch: pa.RecordBatch, schema: str, num_chunks: int, *, backend: str = "auto"
+) -> List[pa.Array]:
+    """Signature-parity alias of :func:`serialize_record_batch`
+    (≙ ``src/lib.rs:130-147``)."""
+    return serialize_record_batch(batch, schema, num_chunks, backend=backend)

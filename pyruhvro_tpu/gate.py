@@ -1,0 +1,54 @@
+"""Fast-path schema gate.
+
+≙ ``fast_decode::is_supported`` (``ruhvro/src/fast_decode.rs:38-61``):
+the top level must be a record, and every reachable type must be in the
+fast subset — primitives (null/boolean/int/long/float/double/string),
+date / timestamp-millis / timestamp-micros logical types, enum, record,
+union, array, map. Outside the subset (bytes, fixed, decimal, uuid,
+duration, time-millis/micros, local-timestamps): the call silently uses
+the general fallback path, exactly like the reference
+(``deserialize.rs:26-29``).
+"""
+
+from __future__ import annotations
+
+from .schema.model import (
+    Array,
+    AvroType,
+    Enum,
+    Map,
+    Primitive,
+    Record,
+    Union,
+)
+
+__all__ = ["is_supported"]
+
+_SUPPORTED_LOGICAL = {
+    None: ("null", "boolean", "int", "long", "float", "double", "string"),
+    "date": ("int",),
+    "timestamp-millis": ("long",),
+    "timestamp-micros": ("long",),
+}
+
+
+def _inner(t: AvroType) -> bool:
+    if isinstance(t, Primitive):
+        allowed = _SUPPORTED_LOGICAL.get(t.logical)
+        return allowed is not None and t.name in allowed
+    if isinstance(t, Enum):
+        return True
+    if isinstance(t, Record):
+        return all(_inner(f.type) for f in t.fields)
+    if isinstance(t, Union):
+        return all(_inner(v) for v in t.variants)
+    if isinstance(t, Array):
+        return _inner(t.items)
+    if isinstance(t, Map):
+        return _inner(t.values)
+    return False  # Fixed (incl. decimal/duration), unknown
+
+
+def is_supported(t: AvroType) -> bool:
+    """True if the TPU fast path can handle this top-level schema."""
+    return isinstance(t, Record) and _inner(t)

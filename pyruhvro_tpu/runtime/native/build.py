@@ -1,0 +1,68 @@
+"""Build the native host shim on demand.
+
+No pybind11 in this environment, so ``packer.cpp`` uses the raw CPython C
+API and we compile it directly with g++ into an extension module next to
+this file. Build happens at first import (cached by mtime); failures are
+non-fatal — ``runtime.pack`` falls back to vectorized numpy.
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import os
+import subprocess
+import sysconfig
+import threading
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_HERE, "packer.cpp")
+_lock = threading.Lock()
+_module = None
+_tried = False
+
+
+def _so_path() -> str:
+    suffix = sysconfig.get_config_var("EXT_SUFFIX") or ".so"
+    return os.path.join(_HERE, "_pyruhvro_native" + suffix)
+
+
+def _needs_build(so: str) -> bool:
+    return (not os.path.exists(so)) or os.path.getmtime(so) < os.path.getmtime(_SRC)
+
+
+def _compile(so: str) -> None:
+    include = sysconfig.get_paths()["include"]
+    tmp = f"{so}.{os.getpid()}.tmp"  # per-process: concurrent builds can't clobber
+    cmd = [
+        "g++", "-O3", "-shared", "-fPIC", "-std=c++17", "-pthread",
+        "-I", include, _SRC, "-o", tmp,
+    ]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, text=True)
+        os.replace(tmp, so)
+    finally:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+
+
+def load_native():
+    """Return the compiled ``_pyruhvro_native`` module, or None if the
+    toolchain is unavailable."""
+    global _module, _tried
+    if _module is not None or _tried:
+        return _module
+    with _lock:
+        if _module is not None or _tried:
+            return _module
+        _tried = True
+        so = _so_path()
+        try:
+            if _needs_build(so):
+                _compile(so)
+            spec = importlib.util.spec_from_file_location("_pyruhvro_native", so)
+            mod = importlib.util.module_from_spec(spec)
+            spec.loader.exec_module(mod)
+            _module = mod
+        except Exception:
+            _module = None
+        return _module

@@ -1,0 +1,275 @@
+// Host-side native shim: gather a Python list[bytes] into device-ready
+// buffers with the GIL released around the copy work.
+//
+// This is the C++ analogue of the reference's PyO3 binding layer
+// (src/lib.rs:29-33 extract_bytes_list + the GIL release at :64-69): the
+// one host-side task that must be native. Python cannot release the GIL
+// around a byte-gather loop; numpy's vectorized fallback needs three
+// passes (join + cumsum + fancy scatter). Here: one pass, multithreaded.
+//
+// Exposed functions (CPython C API, no pybind11 — see repo environment):
+//   pack_padded(data: list[bytes|bytearray|memoryview], out: buffer2d,
+//               lengths: buffer_int32) -> total_bytes
+//       Scatter record i into out[i, :len_i] (rows zero-padded by caller
+//       or pre-zeroed here only where written; caller passes zeroed or
+//       reused buffer — we also zero the tail of each row).
+//   concat(data: list[bytes], out: buffer1d, offsets: buffer_int64) -> total
+//       Flat concatenation + record start offsets (offsets has n+1 slots).
+//
+// Both release the GIL during copying and split work across hardware
+// threads for large inputs.
+
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+
+#include <cstdint>
+#include <cstring>
+#include <thread>
+#include <vector>
+
+namespace {
+
+struct Span {
+  const char* ptr;
+  Py_ssize_t len;
+};
+
+// Collect (ptr, len) for every item while holding the GIL. Returns false
+// (with a Python error set) on non-bytes-like items.
+bool collect_spans(PyObject* list, std::vector<Span>& spans,
+                   std::vector<Py_buffer>& views) {
+  Py_ssize_t n = PySequence_Fast_GET_SIZE(list);
+  spans.reserve(static_cast<size_t>(n));
+  PyObject** items = PySequence_Fast_ITEMS(list);
+  for (Py_ssize_t i = 0; i < n; i++) {
+    PyObject* item = items[i];
+    if (PyBytes_Check(item)) {
+      spans.push_back({PyBytes_AS_STRING(item), PyBytes_GET_SIZE(item)});
+    } else {
+      Py_buffer view;
+      if (PyObject_GetBuffer(item, &view, PyBUF_SIMPLE) != 0) {
+        PyErr_Format(PyExc_TypeError,
+                     "item %zd is not bytes-like", i);
+        return false;
+      }
+      views.push_back(view);
+      spans.push_back({static_cast<const char*>(view.buf), view.len});
+    }
+  }
+  return true;
+}
+
+void release_views(std::vector<Py_buffer>& views) {
+  for (auto& v : views) PyBuffer_Release(&v);
+}
+
+int num_threads_for(size_t total_bytes) {
+  if (total_bytes < (1u << 20)) return 1;  // <1MB: threads cost more than copy
+  unsigned hw = std::thread::hardware_concurrency();
+  return static_cast<int>(hw ? (hw > 16 ? 16 : hw) : 4);
+}
+
+template <typename Fn>
+void parallel_rows(Py_ssize_t n, size_t total_bytes, Fn&& fn) {
+  int nt = num_threads_for(total_bytes);
+  if (nt <= 1 || n < nt) {
+    fn(0, n);
+    return;
+  }
+  std::vector<std::thread> threads;
+  Py_ssize_t per = n / nt;
+  for (int t = 0; t < nt; t++) {
+    Py_ssize_t a = t * per;
+    Py_ssize_t b = (t == nt - 1) ? n : a + per;
+    threads.emplace_back([&fn, a, b] { fn(a, b); });
+  }
+  for (auto& th : threads) th.join();
+}
+
+PyObject* pack_padded(PyObject*, PyObject* args) {
+  PyObject* data_obj;
+  Py_buffer out;
+  Py_buffer lengths;
+  if (!PyArg_ParseTuple(args, "Ow*w*", &data_obj, &out, &lengths)) {
+    return nullptr;
+  }
+  PyObject* fast = PySequence_Fast(data_obj, "expected a sequence of bytes");
+  if (!fast) {
+    PyBuffer_Release(&out);
+    PyBuffer_Release(&lengths);
+    return nullptr;
+  }
+  Py_ssize_t n = PySequence_Fast_GET_SIZE(fast);
+
+  std::vector<Span> spans;
+  std::vector<Py_buffer> views;
+  bool ok = collect_spans(fast, spans, views);
+
+  Py_ssize_t pad_len = 0;
+  size_t total = 0;
+  if (ok) {
+    if (n > 0) {
+      if (out.len % n != 0) {
+        PyErr_SetString(PyExc_ValueError,
+                        "out buffer size not divisible by record count");
+        ok = false;
+      } else {
+        pad_len = out.len / n;
+      }
+    }
+    if (ok && lengths.len < n * static_cast<Py_ssize_t>(sizeof(int32_t))) {
+      PyErr_SetString(PyExc_ValueError, "lengths buffer too small");
+      ok = false;
+    }
+  }
+  if (ok) {
+    for (auto& s : spans) {
+      if (s.len > pad_len) {
+        PyErr_Format(PyExc_ValueError,
+                     "record of %zd bytes exceeds row width %zd",
+                     s.len, pad_len);
+        ok = false;
+        break;
+      }
+      if (s.len > INT32_MAX) {  // keep parity with numpy lengths_to_i32
+        PyErr_SetString(PyExc_ValueError, "record too long for int32 length");
+        ok = false;
+        break;
+      }
+      total += static_cast<size_t>(s.len);
+    }
+  }
+
+  if (ok) {
+    char* out_base = static_cast<char*>(out.buf);
+    int32_t* len_base = static_cast<int32_t*>(lengths.buf);
+    Py_BEGIN_ALLOW_THREADS
+    parallel_rows(n, total, [&](Py_ssize_t a, Py_ssize_t b) {
+      for (Py_ssize_t i = a; i < b; i++) {
+        const Span& s = spans[static_cast<size_t>(i)];
+        char* row = out_base + i * pad_len;
+        if (s.len) std::memcpy(row, s.ptr, static_cast<size_t>(s.len));
+        if (s.len < pad_len)
+          std::memset(row + s.len, 0, static_cast<size_t>(pad_len - s.len));
+        len_base[i] = static_cast<int32_t>(s.len);
+      }
+    });
+    Py_END_ALLOW_THREADS
+  }
+
+  release_views(views);
+  Py_DECREF(fast);
+  PyBuffer_Release(&out);
+  PyBuffer_Release(&lengths);
+  if (!ok) return nullptr;
+  return PyLong_FromSize_t(total);
+}
+
+PyObject* concat(PyObject*, PyObject* args) {
+  PyObject* data_obj;
+  Py_buffer out;
+  Py_buffer offsets;
+  if (!PyArg_ParseTuple(args, "Ow*w*", &data_obj, &out, &offsets)) {
+    return nullptr;
+  }
+  PyObject* fast = PySequence_Fast(data_obj, "expected a sequence of bytes");
+  if (!fast) {
+    PyBuffer_Release(&out);
+    PyBuffer_Release(&offsets);
+    return nullptr;
+  }
+  Py_ssize_t n = PySequence_Fast_GET_SIZE(fast);
+
+  std::vector<Span> spans;
+  std::vector<Py_buffer> views;
+  bool ok = collect_spans(fast, spans, views);
+
+  size_t total = 0;
+  std::vector<int64_t> offs;
+  if (ok) {
+    offs.reserve(static_cast<size_t>(n) + 1);
+    offs.push_back(0);
+    for (auto& s : spans) {
+      total += static_cast<size_t>(s.len);
+      offs.push_back(static_cast<int64_t>(total));
+    }
+    if (offsets.len < static_cast<Py_ssize_t>((n + 1) * sizeof(int64_t))) {
+      PyErr_SetString(PyExc_ValueError, "offsets buffer too small");
+      ok = false;
+    } else if (out.len < static_cast<Py_ssize_t>(total)) {
+      PyErr_SetString(PyExc_ValueError, "out buffer too small");
+      ok = false;
+    }
+  }
+
+  if (ok) {
+    char* out_base = static_cast<char*>(out.buf);
+    std::memcpy(offsets.buf, offs.data(), (n + 1) * sizeof(int64_t));
+    Py_BEGIN_ALLOW_THREADS
+    parallel_rows(n, total, [&](Py_ssize_t a, Py_ssize_t b) {
+      for (Py_ssize_t i = a; i < b; i++) {
+        const Span& s = spans[static_cast<size_t>(i)];
+        if (s.len)
+          std::memcpy(out_base + offs[static_cast<size_t>(i)], s.ptr,
+                      static_cast<size_t>(s.len));
+      }
+    });
+    Py_END_ALLOW_THREADS
+  }
+
+  release_views(views);
+  Py_DECREF(fast);
+  PyBuffer_Release(&out);
+  PyBuffer_Release(&offsets);
+  if (!ok) return nullptr;
+  return PyLong_FromSize_t(total);
+}
+
+PyObject* max_len(PyObject*, PyObject* args) {
+  PyObject* data_obj;
+  if (!PyArg_ParseTuple(args, "O", &data_obj)) return nullptr;
+  PyObject* fast = PySequence_Fast(data_obj, "expected a sequence of bytes");
+  if (!fast) return nullptr;
+  Py_ssize_t n = PySequence_Fast_GET_SIZE(fast);
+  PyObject** items = PySequence_Fast_ITEMS(fast);
+  Py_ssize_t best = 0;
+  Py_ssize_t total = 0;
+  for (Py_ssize_t i = 0; i < n; i++) {
+    Py_ssize_t len;
+    if (PyBytes_Check(items[i])) {
+      len = PyBytes_GET_SIZE(items[i]);
+    } else {
+      len = PyObject_Length(items[i]);
+      if (len < 0) {
+        Py_DECREF(fast);
+        return nullptr;
+      }
+    }
+    if (len > best) best = len;
+    total += len;
+  }
+  Py_DECREF(fast);
+  return Py_BuildValue("(nn)", best, total);
+}
+
+PyMethodDef methods[] = {
+    {"pack_padded", pack_padded, METH_VARARGS,
+     "pack_padded(data, out_2d, lengths_i32) -> total_bytes"},
+    {"concat", concat, METH_VARARGS,
+     "concat(data, out_1d, offsets_i64) -> total_bytes"},
+    {"max_len", max_len, METH_VARARGS,
+     "max_len(data) -> (max_record_len, total_bytes)"},
+    {nullptr, nullptr, 0, nullptr},
+};
+
+PyModuleDef module = {
+    PyModuleDef_HEAD_INIT, "_pyruhvro_native",
+    "Native host shim for pyruhvro_tpu (byte packing, GIL-released).",
+    -1, methods,
+};
+
+}  // namespace
+
+PyMODINIT_FUNC PyInit__pyruhvro_native(void) {
+  return PyModule_Create(&module);
+}

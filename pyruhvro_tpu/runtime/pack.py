@@ -1,0 +1,95 @@
+"""Record packing: ``list[bytes]`` → device-ready numpy buffers.
+
+The TPU decode kernel consumes a padded byte matrix (one record per row,
+rows padded to a common bucketed width) plus per-record lengths. Packing
+runs through the C++ shim when available (single pass, multithreaded,
+GIL released — ≙ the reference's ``extract_bytes_list`` + GIL-release,
+``src/lib.rs:29-33,64-69``) and otherwise through a fully vectorized
+numpy path (no per-record Python loop).
+
+Widths and row counts are bucketed to powers of two so the jitted kernel
+cache (keyed by ``(schema, R, L)``) stays small.
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence, Tuple
+
+import numpy as np
+
+from .native.build import load_native
+
+__all__ = ["pack_padded", "concat_records", "bucket_len"]
+
+
+def bucket_len(n: int, minimum: int = 16) -> int:
+    """Round up to a power of two (≥ minimum) to bound jit-cache size."""
+    b = minimum
+    while b < n:
+        b <<= 1
+    return b
+
+
+def _lengths(data: Sequence[bytes]) -> np.ndarray:
+    return np.fromiter((len(d) for d in data), dtype=np.int64, count=len(data))
+
+
+def pack_padded(
+    data: Sequence[bytes], pad_to: int = None, bucket: bool = True
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Return ``(tile[R, L] uint8, lengths[R] int32)``.
+
+    ``L`` is the max record length, bucketed to a power of two unless
+    ``pad_to`` is given. Rows are zero-padded past each record's length.
+    """
+    n = len(data)
+    native = load_native()
+    if n == 0:
+        L = pad_to or 16
+        return np.zeros((0, L), np.uint8), np.zeros(0, np.int32)
+
+    if native is not None:
+        max_len, _total = native.max_len(data)
+        L = pad_to if pad_to is not None else (
+            bucket_len(max(max_len, 1)) if bucket else max(max_len, 1))
+        tile = np.empty((n, L), np.uint8)
+        lengths = np.empty(n, np.int32)
+        native.pack_padded(data, tile, lengths)
+        return tile, lengths
+
+    lens = _lengths(data)
+    max_len = int(lens.max()) if n else 1
+    L = pad_to if pad_to is not None else (
+        bucket_len(max(max_len, 1)) if bucket else max(max_len, 1))
+    if max_len > L:
+        raise ValueError(f"record of {max_len} bytes exceeds row width {L}")
+    flat = np.frombuffer(b"".join(data), np.uint8)
+    tile = np.zeros((n, L), np.uint8)
+    starts = np.repeat(np.cumsum(lens) - lens, lens)
+    pos = np.arange(flat.shape[0], dtype=np.int64) - starts
+    rows = np.repeat(np.arange(n, dtype=np.int64), lens)
+    tile[rows, pos] = flat
+    return tile, lengths_to_i32(lens)
+
+
+def lengths_to_i32(lens: np.ndarray) -> np.ndarray:
+    if lens.max(initial=0) > np.iinfo(np.int32).max:
+        raise ValueError("record too long for int32 length")
+    return lens.astype(np.int32)
+
+
+def concat_records(data: Sequence[bytes]) -> Tuple[np.ndarray, np.ndarray]:
+    """Return ``(flat[total] uint8, offsets[R+1] int64)``."""
+    n = len(data)
+    native = load_native()
+    if native is not None and n:
+        _max, total = native.max_len(data)
+        flat = np.empty(total, np.uint8)
+        offsets = np.empty(n + 1, np.int64)
+        native.concat(data, flat, offsets)
+        return flat, offsets
+    lens = _lengths(data)
+    offsets = np.zeros(n + 1, np.int64)
+    np.cumsum(lens, out=offsets[1:])
+    flat = np.frombuffer(b"".join(data), np.uint8).copy() if n else np.zeros(0, np.uint8)
+    return flat, offsets

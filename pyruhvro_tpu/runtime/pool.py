@@ -1,0 +1,42 @@
+"""Process-global host thread pool.
+
+≙ the reference's ``OnceLock<tokio::runtime::Runtime>``
+(``ruhvro/src/lib.rs:12-16``): created on first use, lives for the
+process, services all chunk tasks. Python threads only overlap where the
+work releases the GIL (the C++ packer, pyarrow, numpy, JAX dispatch);
+the pure-Python fallback codec is GIL-bound, so chunk threading there
+preserves the API contract rather than adding speed — the speed path is
+the TPU backend.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from concurrent.futures import ThreadPoolExecutor
+from typing import Callable, List, Sequence
+
+__all__ = ["get_pool", "map_chunks"]
+
+_pool = None
+_lock = threading.Lock()
+
+
+def get_pool() -> ThreadPoolExecutor:
+    global _pool
+    if _pool is None:
+        with _lock:
+            if _pool is None:
+                _pool = ThreadPoolExecutor(
+                    max_workers=os.cpu_count() or 4,
+                    thread_name_prefix="pyruhvro",
+                )
+    return _pool
+
+
+def map_chunks(fn: Callable, chunks: Sequence) -> List:
+    """Run ``fn`` over chunks on the pool, preserving order; a single
+    chunk runs inline (no thread hop)."""
+    if len(chunks) == 1:
+        return [fn(chunks[0])]
+    return list(get_pool().map(fn, chunks))

@@ -1,0 +1,118 @@
+"""Public-API parity tests (≙ ``src/lib.rs`` + ``deserialize.rs`` tests)."""
+
+import json
+
+import pyarrow as pa
+import pytest
+
+import pyruhvro_tpu as pv
+from pyruhvro_tpu.runtime.chunking import chunk_bounds, clamp_chunks
+from pyruhvro_tpu.utils.datagen import KAFKA_SCHEMA_JSON, kafka_style_datums
+
+FLAT_SCHEMA = json.dumps({
+    "type": "record", "name": "F",
+    "fields": [
+        {"name": "i", "type": "int"},
+        {"name": "l", "type": "long"},
+        {"name": "s", "type": "string"},
+    ],
+})
+
+UNSUPPORTED_SCHEMA = json.dumps({  # bytes is outside the fast subset
+    "type": "record", "name": "U",
+    "fields": [{"name": "b", "type": "bytes"}],
+})
+
+
+def test_clamp_chunks_reference_parity():
+    # ≙ deserialize.rs:50-55 and its tests
+    assert clamp_chunks(0, 10) == 1
+    assert clamp_chunks(4, 10) == 4
+    assert clamp_chunks(100, 10) == 10
+    assert clamp_chunks(8, 0) == 1
+    assert clamp_chunks(0, 0) == 1
+
+
+def test_chunk_bounds_remainder_to_last():
+    # ≙ build_slices: even chunks, remainder folded into the LAST chunk
+    assert chunk_bounds(10, 3) == [(0, 3), (3, 6), (6, 10)]
+    assert chunk_bounds(5, 8) == [(0, 1), (1, 2), (2, 3), (3, 4), (4, 5)]
+    assert chunk_bounds(0, 4) == [(0, 0)]
+
+
+@pytest.mark.parametrize("backend", ["host", "auto"])
+def test_deserialize_array(backend):
+    datums = kafka_style_datums(50, seed=1)
+    batch = pv.deserialize_array(datums, KAFKA_SCHEMA_JSON, backend=backend)
+    assert isinstance(batch, pa.RecordBatch)
+    assert batch.num_rows == 50
+    assert batch.schema.names[0] == "name"
+
+
+@pytest.mark.parametrize("backend", ["host", "auto"])
+def test_deserialize_array_threaded_chunked_shape(backend):
+    datums = kafka_style_datums(10, seed=2)
+    batches = pv.deserialize_array_threaded(
+        datums, KAFKA_SCHEMA_JSON, 3, backend=backend)
+    assert [b.num_rows for b in batches] == [3, 3, 4]
+    merged = pa.Table.from_batches(batches)
+    whole = pv.deserialize_array(datums, KAFKA_SCHEMA_JSON, backend=backend)
+    assert merged.to_pylist() == pa.Table.from_batches([whole]).to_pylist()
+    # spawn variant: same result
+    spawn = pv.deserialize_array_threaded_spawn(
+        datums, KAFKA_SCHEMA_JSON, 3, backend=backend)
+    assert [b.num_rows for b in spawn] == [3, 3, 4]
+
+
+@pytest.mark.parametrize("backend", ["host", "auto"])
+def test_serialize_round_trip(backend):
+    datums = kafka_style_datums(20, seed=3)
+    batch = pv.deserialize_array(datums, KAFKA_SCHEMA_JSON, backend=backend)
+    chunks = pv.serialize_record_batch(batch, KAFKA_SCHEMA_JSON, 4,
+                                       backend=backend)
+    assert len(chunks) == 4
+    assert all(isinstance(c, pa.Array) for c in chunks)
+    out = [bytes(v.as_py()) for c in chunks for v in c]
+    assert out == datums
+    spawn = pv.serialize_record_batch_spawn(batch, KAFKA_SCHEMA_JSON, 4,
+                                            backend=backend)
+    assert [bytes(v.as_py()) for c in spawn for v in c] == out
+
+
+def test_unsupported_schema_silently_falls_back():
+    # ≙ deserialize.rs:26-29 — the gate is silent under auto
+    datums = [b"\x04\xaa\xbb"]  # bytes field, 2 bytes
+    batch = pv.deserialize_array(datums, UNSUPPORTED_SCHEMA, backend="auto")
+    assert batch.to_pylist() == [{"b": b"\xaa\xbb"}]
+
+
+def test_backend_tpu_rejects_unsupported_schema():
+    with pytest.raises(ValueError, match="outside the TPU fast-path subset"):
+        pv.deserialize_array([b"\x00"], UNSUPPORTED_SCHEMA, backend="tpu")
+
+
+def test_backend_validation():
+    with pytest.raises(ValueError, match="backend must be"):
+        pv.deserialize_array([], FLAT_SCHEMA, backend="gpu")
+
+
+def test_empty_inputs():
+    assert pv.deserialize_array([], FLAT_SCHEMA).num_rows == 0
+    batches = pv.deserialize_array_threaded([], FLAT_SCHEMA, 8)
+    assert len(batches) == 1 and batches[0].num_rows == 0
+
+
+def test_is_supported_gate():
+    assert pv.is_supported(pv.parse_schema(KAFKA_SCHEMA_JSON))
+    assert pv.is_supported(pv.parse_schema(FLAT_SCHEMA))
+    assert not pv.is_supported(pv.parse_schema(UNSUPPORTED_SCHEMA))
+    assert not pv.is_supported(pv.parse_schema('"string"'))  # non-record top
+    # time-millis is outside the subset; date is inside
+    mk = lambda lt, t: json.dumps({
+        "type": "record", "name": "R",
+        "fields": [{"name": "x", "type": {"type": t, "logicalType": lt}}]})
+    assert pv.is_supported(pv.parse_schema(mk("date", "int")))
+    assert pv.is_supported(pv.parse_schema(mk("timestamp-millis", "long")))
+    assert pv.is_supported(pv.parse_schema(mk("timestamp-micros", "long")))
+    assert not pv.is_supported(pv.parse_schema(mk("time-millis", "int")))
+    assert not pv.is_supported(pv.parse_schema(mk("time-micros", "long")))
