@@ -21,11 +21,13 @@ errors if unsupported), ``"host"`` (force the general path).
 
 from __future__ import annotations
 
+import warnings
 from typing import List, Optional, Sequence
 
 import pyarrow as pa
 
 from .gate import is_supported
+from .ops import UnsupportedOnDevice
 from .fallback.decoder import decode_to_record_batch
 from .fallback.encoder import encode_record_batch
 from .runtime.chunking import chunk_bounds
@@ -50,6 +52,11 @@ def _device_codec(entry: SchemaEntry, backend: str):
     """
     if backend == "host":
         return None
+    if backend == "auto" and entry._extras.get("device_failure") is not None:
+        # device codec for THIS schema already blew up; don't re-pay the
+        # failed (potentially seconds-long) init on every call. Other
+        # schemas still get the device path.
+        return None
     supported = is_supported(entry.ir)
     if backend == "auto" and not supported:
         return None
@@ -66,13 +73,43 @@ def _device_codec(entry: SchemaEntry, backend: str):
             raise RuntimeError(
                 f"TPU backend is not available in this build: {e}"
             ) from e
+        # missing module = deliberately host-only build, not a broken
+        # backend: stay silent (reference fallback semantics)
         return None
     try:
         return get_device_codec(entry)
-    except Exception:
+    except UnsupportedOnDevice:
+        # schema outside the *device* subset (e.g. nested repetition): the
+        # silent fallback here mirrors the reference's unsupported-schema
+        # gate (deserialize.rs:26-29)
         if backend == "tpu":
             raise
         return None
+    except Exception as e:
+        # a *broken backend* is not the reference's silent-fallback case:
+        # surface it once, remember the failure for this schema, degrade
+        # in 'auto' / raise in 'tpu'
+        if backend == "tpu":
+            raise
+        with entry._lock:
+            entry._extras["device_failure"] = e
+        _warn_device_failure(e)
+        return None
+
+
+_warned_device_failure = False
+
+
+def _warn_device_failure(e: BaseException) -> None:
+    global _warned_device_failure
+    if not _warned_device_failure:
+        _warned_device_failure = True
+        warnings.warn(
+            f"pyruhvro_tpu device backend failed to initialize; falling back "
+            f"to the (much slower) host path: {e!r}",
+            RuntimeWarning,
+            stacklevel=4,  # user -> api fn -> _device_codec -> here
+        )
 
 
 def _check_backend(backend: str) -> str:

@@ -148,7 +148,12 @@ def compile_reader(t: AvroType) -> Callable:
                     _, pos = read_long(buf, pos)
                 for _ in range(count):
                     raw, pos = read_bytes(buf, pos)
-                    k = raw.decode("utf-8")
+                    try:
+                        k = raw.decode("utf-8")
+                    except UnicodeDecodeError as e:
+                        raise MalformedAvro(
+                            f"invalid UTF-8 in map key: {e}"
+                        ) from None
                     v, pos = value_reader(buf, pos)
                     out.append((k, v))
         return read_map

@@ -19,6 +19,7 @@ then writes each row as one Avro datum. Reference semantics preserved:
 
 from __future__ import annotations
 
+import decimal
 import uuid as _uuid
 from typing import List, Sequence
 
@@ -169,8 +170,13 @@ def _is_simple(t: AvroType) -> bool:
     return isinstance(t, (Primitive, Enum)) and getattr(t, "logical", None) is None
 
 
+# exact context for decimal128: the default context's prec=28 would silently
+# round values with 29-38 significant digits (the reference's i128 path is exact)
+_DEC_CTX = decimal.Context(prec=76)
+
+
 def _unscaled(v, scale: int) -> int:
-    return int(v.scaleb(scale).to_integral_value())
+    return int(v.scaleb(scale, _DEC_CTX).to_integral_value())
 
 
 # ---------------------------------------------------------------------------
@@ -178,7 +184,21 @@ def _unscaled(v, scale: int) -> int:
 # ---------------------------------------------------------------------------
 
 def compile_writer(t: AvroType):
-    """Build a ``writer(out: bytearray, value)`` closure for ``t``."""
+    """Build a ``writer(out: bytearray, value)`` closure for ``t``.
+
+    Every non-union writer rejects ``None`` with a clear error (unions
+    route nulls to their null branch; bare nulls elsewhere are a schema
+    violation the wire format cannot express)."""
+    w = _compile_writer(t)
+    if isinstance(t, Union) or (isinstance(t, Primitive) and t.name == "null"):
+        return w
+    what = type(t).__name__.lower()
+    if isinstance(t, Primitive):
+        what = t.logical or t.name
+    return _non_null(w, what)
+
+
+def _compile_writer(t: AvroType):
     if isinstance(t, Primitive):
         name = t.name
         if name == "null":
@@ -274,6 +294,57 @@ def compile_writer(t: AvroType):
     raise NotImplementedError(f"no writer for {t!r}")
 
 
+def _types_compatible(actual: pa.DataType, expected: pa.DataType) -> bool:
+    """Structural type equality ignoring *container child* field names, so
+    e.g. a list child named "element" (Parquet convention) matches the
+    expected "item". Struct children still match by name — record fields
+    are name-matched, like the reference (``serialization_containers.rs:248-267``)."""
+    if actual.equals(expected):
+        return True
+    if pa.types.is_list(actual) and pa.types.is_list(expected):
+        return _types_compatible(actual.value_type, expected.value_type)
+    if pa.types.is_map(actual) and pa.types.is_map(expected):
+        return _types_compatible(
+            actual.key_type, expected.key_type
+        ) and _types_compatible(actual.item_type, expected.item_type)
+    if pa.types.is_struct(actual) and pa.types.is_struct(expected):
+        if actual.num_fields != expected.num_fields:
+            return False
+        return all(
+            actual.field(i).name == expected.field(i).name
+            and _types_compatible(actual.field(i).type, expected.field(i).type)
+            for i in range(actual.num_fields)
+        )
+    if pa.types.is_union(actual) and pa.types.is_union(expected):
+        if actual.mode != expected.mode:
+            # dense vs sparse changes child indexing; extract_rows assumes sparse
+            return False
+        if actual.num_fields != expected.num_fields or list(
+            actual.type_codes
+        ) != list(expected.type_codes):
+            return False
+        return all(
+            _types_compatible(actual.field(i).type, expected.field(i).type)
+            for i in range(actual.num_fields)
+        )
+    return False
+
+
+def _non_null(writer, what: str):
+    """Nulls are representable only under a union with a null variant; the
+    lenient type check admits nullable child fields (Parquet-style batches),
+    so a null in a non-nullable Avro position must fail with a clear error
+    rather than a crash deep in a wire writer."""
+    def checked(out, v):
+        if v is None:
+            raise ValueError(
+                f"null value for non-nullable Avro {what} "
+                f"(no null union at this position in the schema)"
+            )
+        writer(out, v)
+    return checked
+
+
 def encode_record_batch(batch: pa.RecordBatch, t: Record) -> List[bytes]:
     """Encode every row of ``batch`` as one Avro datum
     (≙ ``serialization_containers::serialize``, ``:13-22``).
@@ -293,7 +364,7 @@ def encode_record_batch(batch: pa.RecordBatch, t: Record) -> List[bytes]:
             )
         expected = to_arrow_field(f.type, name=f.name, nullable=False)
         actual = batch.schema.field(idx).type
-        if actual != expected.type:
+        if not _types_compatible(actual, expected.type):
             raise ValueError(
                 f"column {f.name!r} has Arrow type {actual}, but the Avro "
                 f"schema requires {expected.type}"
@@ -303,7 +374,10 @@ def encode_record_batch(batch: pa.RecordBatch, t: Record) -> List[bytes]:
     out: List[bytes] = []
     for i in range(n):
         buf = bytearray()
-        for _name, rows, writer in cols:
-            writer(buf, rows[i])
+        for name, rows, writer in cols:
+            try:
+                writer(buf, rows[i])
+            except ValueError as e:
+                raise ValueError(f"column {name!r}, row {i}: {e}") from None
         out.append(bytes(buf))
     return out

@@ -128,6 +128,8 @@ def long_size(value: int) -> int:
 
 
 def write_long(out: bytearray, value: int) -> None:
+    if not -(1 << 63) <= value < (1 << 63):
+        raise ValueError(f"value {value} out of int64 range for Avro long")
     z = zigzag_encode(value) & ((1 << 64) - 1)
     while z >= 0x80:
         out.append((z & 0x7F) | 0x80)

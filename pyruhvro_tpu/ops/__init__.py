@@ -1,0 +1,22 @@
+"""Device (TPU) codec ops: vectorized Avro wire-format kernels in JAX.
+
+Submodules (imported lazily so that merely importing :mod:`pyruhvro_tpu`
+never pays the JAX startup cost — the reference's host-only import path
+is similarly cheap):
+
+* :mod:`.varint`    — vectorized zig-zag varint read/write primitives
+* :mod:`.fieldprog` — Avro schema IR → static field program (output specs)
+* :mod:`.decode`    — the jitted record-walk decode kernel
+* :mod:`.arrow_build` — device outputs → ``pyarrow`` arrays
+* :mod:`.encode`    — the jitted encode kernel (Arrow → wire bytes)
+* :mod:`.codec`     — ``get_device_codec(entry)``, the object ``api.py`` uses
+"""
+
+__all__ = ["UnsupportedOnDevice"]
+
+
+class UnsupportedOnDevice(ValueError):
+    """Schema is valid but outside the *device* kernel's subset (e.g. an
+    array nested inside another array/map's items). ``backend='auto'``
+    falls back to the host path silently, matching the reference's
+    unsupported-schema gate (``deserialize.rs:26-29``)."""

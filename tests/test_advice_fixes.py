@@ -1,0 +1,146 @@
+"""Regression tests for the round-1 advisor findings (ADVICE.md)."""
+
+import decimal
+
+import pyarrow as pa
+import pytest
+
+from pyruhvro_tpu.api import deserialize_array, serialize_record_batch
+from pyruhvro_tpu.fallback.decoder import decode_to_record_batch, MalformedAvro
+from pyruhvro_tpu.fallback.encoder import encode_record_batch
+from pyruhvro_tpu.fallback.io import write_long, write_bytes
+from pyruhvro_tpu.schema.cache import get_or_parse_schema
+
+
+DECIMAL_SCHEMA = """
+{"type": "record", "name": "R", "fields": [
+  {"name": "d", "type": {"type": "bytes", "logicalType": "decimal",
+                          "precision": 38, "scale": 4}}
+]}
+"""
+
+
+def test_decimal_38_digit_roundtrip_exact():
+    # 38 significant digits: would be corrupted by the default prec=28 context
+    entry = get_or_parse_schema(DECIMAL_SCHEMA)
+    v = decimal.Decimal("1234567890123456789012345678901234.5678")
+    batch = pa.RecordBatch.from_arrays(
+        [pa.array([v], pa.decimal128(38, 4))], schema=entry.arrow_schema
+    )
+    datums = encode_record_batch(batch, entry.ir)
+    back = decode_to_record_batch(datums, entry.ir, entry.arrow_schema)
+    assert back.column(0)[0].as_py() == v
+
+
+MAP_SCHEMA = """
+{"type": "record", "name": "R", "fields": [
+  {"name": "m", "type": {"type": "map", "values": "int"}}
+]}
+"""
+
+
+def test_map_key_invalid_utf8_is_malformed_avro():
+    entry = get_or_parse_schema(MAP_SCHEMA)
+    buf = bytearray()
+    write_long(buf, 1)          # one map entry
+    write_bytes(buf, b"\xff\xfe")  # invalid UTF-8 key
+    write_long(buf, 7)          # value
+    write_long(buf, 0)          # end of blocks
+    with pytest.raises(MalformedAvro):
+        decode_to_record_batch([bytes(buf)], entry.ir, entry.arrow_schema)
+
+
+def test_write_long_out_of_range_raises():
+    with pytest.raises(ValueError):
+        write_long(bytearray(), 1 << 63)
+    with pytest.raises(ValueError):
+        write_long(bytearray(), -(1 << 63) - 1)
+    # boundaries are fine
+    write_long(bytearray(), (1 << 63) - 1)
+    write_long(bytearray(), -(1 << 63))
+
+
+LIST_SCHEMA = """
+{"type": "record", "name": "R", "fields": [
+  {"name": "xs", "type": {"type": "array", "items": "long"}}
+]}
+"""
+
+
+def test_encode_accepts_parquet_style_list_child_name():
+    entry = get_or_parse_schema(LIST_SCHEMA)
+    # child named "element" (Parquet convention) instead of our "item"
+    dt = pa.list_(pa.field("element", pa.int64(), nullable=True))
+    batch = pa.RecordBatch.from_arrays(
+        [pa.array([[1, 2], [], [3]], dt)], names=["xs"]
+    )
+    datums = encode_record_batch(batch, entry.ir)
+    back = decode_to_record_batch(datums, entry.ir, entry.arrow_schema)
+    assert back.column(0).to_pylist() == [[1, 2], [], [3]]
+
+
+UNION_SCHEMA = """
+{"type": "record", "name": "R", "fields": [
+  {"name": "u", "type": ["int", "string"]}
+]}
+"""
+
+
+def test_encode_rejects_dense_union():
+    # extract_rows indexes sparse-union children by row; dense layout would
+    # silently corrupt values, so the type check must reject it
+    entry = get_or_parse_schema(UNION_SCHEMA)
+    types = pa.array([1, 0, 0], pa.int8())
+    offsets = pa.array([0, 0, 1], pa.int32())
+    dense = pa.UnionArray.from_dense(
+        types, offsets, [pa.array([5, 6], pa.int32()), pa.array(["a"])]
+    )
+    batch = pa.RecordBatch.from_arrays([dense], names=["u"])
+    with pytest.raises(ValueError, match="Arrow type"):
+        encode_record_batch(batch, entry.ir)
+
+
+def test_encode_forbidden_null_clear_error():
+    entry = get_or_parse_schema(MAP_SCHEMA)
+    m = pa.array([[("a", None)]], pa.map_(pa.string(), pa.int32()))
+    batch = pa.RecordBatch.from_arrays([m], names=["m"])
+    with pytest.raises(ValueError, match="null"):
+        encode_record_batch(batch, entry.ir)
+    # nullable-typed children without actual nulls still encode (leniency)
+    m2 = pa.array([[("a", 1)]], pa.map_(pa.string(), pa.int32()))
+    batch2 = pa.RecordBatch.from_arrays([m2], names=["m"])
+    assert len(encode_record_batch(batch2, entry.ir)) == 1
+
+
+def test_encode_sliced_batch_ignores_out_of_window_nulls():
+    entry = get_or_parse_schema(LIST_SCHEMA)
+    arr = pa.array([[1, None], [2, 3]], pa.list_(pa.int64()))
+    batch = pa.RecordBatch.from_arrays([arr], names=["xs"]).slice(1, 1)
+    datums = encode_record_batch(batch, entry.ir)
+    back = decode_to_record_batch(datums, entry.ir, entry.arrow_schema)
+    assert back.column(0).to_pylist() == [[2, 3]]
+
+
+NULLABLE_LIST_SCHEMA = """
+{"type": "record", "name": "R", "fields": [
+  {"name": "xs", "type": ["null", {"type": "array", "items": "long"}]}
+]}
+"""
+
+
+def test_encode_null_nested_under_nullable_column_clear_error():
+    entry = get_or_parse_schema(NULLABLE_LIST_SCHEMA)
+    batch = pa.RecordBatch.from_arrays(
+        [pa.array([[1, None]], pa.list_(pa.int64()))], names=["xs"]
+    )
+    with pytest.raises(ValueError, match="non-nullable"):
+        encode_record_batch(batch, entry.ir)
+
+
+def test_encode_rejects_wrong_type_still():
+    entry = get_or_parse_schema(LIST_SCHEMA)
+    batch = pa.RecordBatch.from_arrays(
+        [pa.array([["a"], ["b"]], pa.list_(pa.string()))], names=["xs"]
+    )
+    with pytest.raises(ValueError, match="Arrow type"):
+        encode_record_batch(batch, entry.ir)
