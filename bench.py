@@ -1,0 +1,193 @@
+#!/usr/bin/env python
+"""Benchmark harness (run by the driver on real TPU hardware).
+
+Measures Avro→Arrow deserialize throughput on the reference's headline
+workload — the 9-field Kafka-style schema of
+``/root/reference/scripts/generate_avro.py:12-41`` — and prints exactly
+ONE JSON line to stdout:
+
+    {"metric": ..., "value": N, "unit": "records/s", "vs_baseline": N}
+
+``vs_baseline`` is the ratio against the reference's published number
+(10k records in 1.17 ms on an 8-core Apple M-series ≈ 8.5M records/s,
+``/root/reference/README.md:30-31``; see BASELINE.md).
+
+Timing protocol mirrors the reference's ``python -m timeit`` best-of-N
+(``scripts/run_benchmarks.sh``): one untimed warmup (jit compile +
+caches), then best of ``--reps`` wall-clock runs.
+
+Detailed per-backend / per-size results go to ``BENCH_DETAILS.json`` and
+stderr, never stdout.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+BASELINE_DECODE_REC_S = 10_000 / 1.17e-3  # README.md:30-31
+BASELINE_ENCODE_REC_S = 10_000 / 1.40e-3  # README.md:24-27
+
+
+def _log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+def _gen_datums(rows: int, unique: int = 50_000):
+    """Kafka-style datums; large row counts tile a unique prefix so host-side
+    pure-Python generation doesn't dominate the harness."""
+    from pyruhvro_tpu.utils.datagen import kafka_style_datums
+
+    base = kafka_style_datums(min(rows, unique), seed=7)
+    if rows <= len(base):
+        return base[:rows]
+    reps = -(-rows // len(base))
+    return (base * reps)[:rows]
+
+
+def _time_best(fn, reps: int) -> float:
+    fn()  # warmup: jit compile, schema cache, allocator steady state
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        fn()
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def bench_deserialize(datums, schema: str, backend: str, chunks: int, reps: int):
+    from pyruhvro_tpu.api import deserialize_array_threaded
+
+    def run():
+        out = deserialize_array_threaded(datums, schema, chunks, backend=backend)
+        return out
+
+    dt = _time_best(run, reps)
+    return len(datums) / dt, dt
+
+
+def bench_serialize(datums, schema: str, backend: str, chunks: int, reps: int):
+    from pyruhvro_tpu.api import deserialize_array, serialize_record_batch
+
+    batch = deserialize_array(datums, schema, backend="host")
+
+    def run():
+        return serialize_record_batch(batch, schema, chunks, backend=backend)
+
+    dt = _time_best(run, reps)
+    return len(datums) / dt, dt
+
+
+def device_available(schema: str) -> bool:
+    try:
+        from pyruhvro_tpu.schema.cache import get_or_parse_schema
+        from pyruhvro_tpu.api import _device_codec
+
+        codec = _device_codec(get_or_parse_schema(schema), "auto")
+        return codec is not None
+    except Exception as e:  # never let probing kill the bench
+        _log(f"device probe failed: {e!r}")
+        return False
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--rows", type=int, default=int(os.environ.get("BENCH_ROWS", 10_000)),
+                    help="row count for the headline metric (baseline config: 10k)")
+    ap.add_argument("--big-rows", type=int, default=int(os.environ.get("BENCH_BIG_ROWS", 1_000_000)),
+                    help="large-batch row count for the scaling measurement (0 = skip)")
+    ap.add_argument("--reps", type=int, default=5)
+    ap.add_argument("--chunks", type=int, default=8)
+    ap.add_argument("--host-cap", type=int, default=20_000,
+                    help="skip host-path timing above this row count (pure-Python path)")
+    args = ap.parse_args()
+
+    from pyruhvro_tpu.utils.datagen import KAFKA_SCHEMA_JSON as schema
+
+    details = {"baseline_decode_rec_s": BASELINE_DECODE_REC_S,
+               "baseline_encode_rec_s": BASELINE_ENCODE_REC_S,
+               "results": []}
+
+    datums = _gen_datums(args.rows)
+    _log(f"generated {len(datums)} datums")
+
+    use_device = device_available(schema)
+    _log(f"device path available: {use_device}")
+
+    backends = (["tpu"] if use_device else []) + ["host"]
+    headline = None  # (rec_s, backend)
+
+    for backend in backends:
+        if backend == "host" and args.rows > args.host_cap:
+            continue
+        try:
+            rec_s, dt = bench_deserialize(datums, schema, backend, args.chunks, args.reps)
+        except Exception as e:
+            _log(f"deserialize[{backend}] failed: {e!r}")
+            continue
+        _log(f"deserialize[{backend}] {args.rows} rows: {dt*1e3:.3f} ms "
+             f"= {rec_s:,.0f} rec/s ({rec_s/BASELINE_DECODE_REC_S:.3f}x baseline)")
+        details["results"].append({
+            "op": "deserialize", "backend": backend, "rows": args.rows,
+            "chunks": args.chunks, "seconds": dt, "records_per_s": rec_s,
+            "vs_baseline": rec_s / BASELINE_DECODE_REC_S,
+        })
+        if headline is None or rec_s > headline[0]:
+            headline = (rec_s, backend, args.rows)
+
+        try:
+            enc_s, enc_dt = bench_serialize(datums, schema, backend, args.chunks, args.reps)
+            _log(f"serialize[{backend}] {args.rows} rows: {enc_dt*1e3:.3f} ms "
+                 f"= {enc_s:,.0f} rec/s ({enc_s/BASELINE_ENCODE_REC_S:.3f}x baseline)")
+            details["results"].append({
+                "op": "serialize", "backend": backend, "rows": args.rows,
+                "chunks": args.chunks, "seconds": enc_dt, "records_per_s": enc_s,
+                "vs_baseline": enc_s / BASELINE_ENCODE_REC_S,
+            })
+        except Exception as e:
+            _log(f"serialize[{backend}] failed: {e!r}")
+
+    # large-batch scaling point (device only: the host path is O(minutes) there)
+    if use_device and args.big_rows:
+        try:
+            big = _gen_datums(args.big_rows)
+            rec_s, dt = bench_deserialize(big, schema, "tpu", args.chunks,
+                                          max(2, args.reps - 2))
+            _log(f"deserialize[tpu] {args.big_rows} rows: {dt*1e3:.1f} ms "
+                 f"= {rec_s:,.0f} rec/s ({rec_s/BASELINE_DECODE_REC_S:.3f}x baseline)")
+            details["results"].append({
+                "op": "deserialize", "backend": "tpu", "rows": args.big_rows,
+                "chunks": args.chunks, "seconds": dt, "records_per_s": rec_s,
+                "vs_baseline": rec_s / BASELINE_DECODE_REC_S,
+            })
+            if headline is None or rec_s > headline[0]:
+                headline = (rec_s, "tpu", args.big_rows)
+        except Exception as e:
+            _log(f"large-batch bench failed: {e!r}")
+
+    try:
+        with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                               "BENCH_DETAILS.json"), "w") as f:
+            json.dump(details, f, indent=2)
+    except OSError as e:
+        _log(f"could not write BENCH_DETAILS.json: {e!r}")
+
+    if headline is None:
+        print(json.dumps({"metric": "deserialize_kafka_rec_s", "value": 0.0,
+                          "unit": "records/s", "vs_baseline": 0.0}))
+        sys.exit(0)
+
+    rec_s, backend, rows = headline
+    print(json.dumps({
+        "metric": f"deserialize_kafka_{backend}_{rows}rows",
+        "value": round(rec_s, 1),
+        "unit": "records/s",
+        "vs_baseline": round(rec_s / BASELINE_DECODE_REC_S, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()

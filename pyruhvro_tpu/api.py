@@ -28,8 +28,8 @@ import pyarrow as pa
 
 from .gate import is_supported
 from .ops import UnsupportedOnDevice
-from .fallback.decoder import decode_to_record_batch
-from .fallback.encoder import encode_record_batch
+from .fallback.decoder import compile_reader, decode_to_record_batch
+from .fallback.encoder import compile_encoder_plan, encode_record_batch
 from .runtime.chunking import chunk_bounds
 from .runtime.pool import map_chunks
 from .schema.cache import SchemaEntry, get_or_parse_schema
@@ -87,29 +87,27 @@ def _device_codec(entry: SchemaEntry, backend: str):
         return None
     except Exception as e:
         # a *broken backend* is not the reference's silent-fallback case:
-        # surface it once, remember the failure for this schema, degrade
-        # in 'auto' / raise in 'tpu'
+        # surface it once per schema, remember the failure, degrade in
+        # 'auto' / raise in 'tpu'. Store only the repr — keeping the live
+        # exception would pin its whole traceback (and every local in the
+        # failed device init) in the process-lifetime schema cache.
         if backend == "tpu":
             raise
         with entry._lock:
-            entry._extras["device_failure"] = e
-        _warn_device_failure(e)
+            entry._extras["device_failure"] = repr(e)
+        warnings.warn(
+            f"pyruhvro_tpu device backend failed to initialize for this "
+            f"schema; falling back to the (much slower) host path: {e!r}",
+            RuntimeWarning,
+            stacklevel=3,  # user -> api fn -> _device_codec
+        )
         return None
 
 
-_warned_device_failure = False
-
-
-def _warn_device_failure(e: BaseException) -> None:
-    global _warned_device_failure
-    if not _warned_device_failure:
-        _warned_device_failure = True
-        warnings.warn(
-            f"pyruhvro_tpu device backend failed to initialize; falling back "
-            f"to the (much slower) host path: {e!r}",
-            RuntimeWarning,
-            stacklevel=4,  # user -> api fn -> _device_codec -> here
-        )
+def _host_reader(entry: SchemaEntry):
+    """Per-schema memoized fallback wire reader (compile once, use on every
+    call/chunk — the host analogue of the schema→kernel cache)."""
+    return entry.get_extra("host_reader", lambda: compile_reader(entry.ir))
 
 
 def _check_backend(backend: str) -> str:
@@ -128,7 +126,9 @@ def deserialize_array(
     codec = _device_codec(entry, backend)
     if codec is not None:
         return codec.decode(data)
-    return decode_to_record_batch(data, entry.ir, entry.arrow_schema)
+    return decode_to_record_batch(
+        data, entry.ir, entry.arrow_schema, _host_reader(entry)
+    )
 
 
 def deserialize_array_threaded(
@@ -147,9 +147,10 @@ def deserialize_array_threaded(
     if codec is not None:
         batch = codec.decode(data)
         return [batch.slice(a, b - a) for a, b in bounds]
-    ir, arrow = entry.ir, entry.arrow_schema
+    ir, arrow, reader = entry.ir, entry.arrow_schema, _host_reader(entry)
     return map_chunks(
-        lambda ab: decode_to_record_batch(data[ab[0]:ab[1]], ir, arrow), bounds
+        lambda ab: decode_to_record_batch(data[ab[0]:ab[1]], ir, arrow, reader),
+        bounds,
     )
 
 
@@ -180,8 +181,9 @@ def serialize_record_batch(
     if codec is not None:
         return [codec.encode(batch.slice(a, b - a)) for a, b in bounds]
     ir = entry.ir
+    plan = entry.get_extra("host_encode_plan", lambda: compile_encoder_plan(ir))
     def encode_chunk(ab):
-        datums = encode_record_batch(batch.slice(ab[0], ab[1] - ab[0]), ir)
+        datums = encode_record_batch(batch.slice(ab[0], ab[1] - ab[0]), ir, plan)
         return pa.array(datums, pa.binary())
     return map_chunks(encode_chunk, bounds)
 

@@ -49,7 +49,7 @@ from .io import (
     read_long,
 )
 
-__all__ = ["compile_reader", "decode_records", "ValuesToArrow", "MalformedAvro"]
+__all__ = ["compile_reader", "decode_records", "MalformedAvro"]
 
 
 # ---------------------------------------------------------------------------
@@ -181,9 +181,15 @@ def compile_reader(t: AvroType) -> Callable:
     raise NotImplementedError(f"no reader for {t!r}")
 
 
-def decode_records(data: Sequence[bytes], t: AvroType) -> List[object]:
-    """Decode each datum fully; trailing bytes are an error."""
-    reader = compile_reader(t)
+def decode_records(
+    data: Sequence[bytes], t: AvroType, reader: Callable = None
+) -> List[object]:
+    """Decode each datum fully; trailing bytes are an error.
+
+    Pass a precompiled ``reader`` (from :func:`compile_reader`, cached per
+    schema via ``SchemaEntry.get_extra``) to skip per-call recompilation."""
+    if reader is None:
+        reader = compile_reader(t)
     out = []
     for datum in data:
         value, pos = reader(datum, 0)
@@ -198,19 +204,6 @@ def decode_records(data: Sequence[bytes], t: AvroType) -> List[object]:
 # ---------------------------------------------------------------------------
 # Stage 2: value trees → Arrow arrays
 # ---------------------------------------------------------------------------
-
-class ValuesToArrow:
-    """Assemble Arrow arrays from value trees for one Avro type
-    (≙ ``complex.rs`` builders, but batch-at-once instead of row-at-a-time;
-    the row-at-a-time protocol is ``append``/``finish``)."""
-
-    def __init__(self, t: AvroType, field: pa.Field):
-        self.t = t
-        self.field = field
-
-    def build(self, values: List[object]) -> pa.Array:
-        return _build_array(self.t, self.field.type, values)
-
 
 def _build_array(t: AvroType, dt: pa.DataType, values: List[object]) -> pa.Array:
     # unwrap nullable-pair unions: values are (branch, v) tuples
@@ -343,7 +336,10 @@ def _build_array(t: AvroType, dt: pa.DataType, values: List[object]) -> pa.Array
 
 
 def decode_to_record_batch(
-    data: Sequence[bytes], t: AvroType, arrow_schema: pa.Schema = None
+    data: Sequence[bytes],
+    t: AvroType,
+    arrow_schema: pa.Schema = None,
+    reader: Callable = None,
 ) -> pa.RecordBatch:
     """Full fallback decode: ``list[bytes]`` → ``pa.RecordBatch``
     (≙ ``per_datum_deserialize_baseline``, ``deserialize.rs:34-48``)."""
@@ -351,7 +347,7 @@ def decode_to_record_batch(
         raise ValueError("top-level Avro schema must be a record")
     if arrow_schema is None:
         arrow_schema = to_arrow_schema(t)
-    rows = decode_records(data, t)
+    rows = decode_records(data, t, reader)
     if not t.fields:
         # zero-column batch must still carry the row count
         return pa.RecordBatch.from_struct_array(

@@ -345,32 +345,45 @@ def _non_null(writer, what: str):
     return checked
 
 
-def encode_record_batch(batch: pa.RecordBatch, t: Record) -> List[bytes]:
+def compile_encoder_plan(t: Record) -> List[tuple]:
+    """Schema-only work of :func:`encode_record_batch`, computed once per
+    schema and reusable across chunks/calls (cache it via
+    ``SchemaEntry.get_extra``): per field ``(name, expected_type, writer)``."""
+    if not isinstance(t, Record):
+        raise ValueError("top-level Avro schema must be a record")
+    return [
+        (f.name, to_arrow_field(f.type, name=f.name, nullable=False).type,
+         f.type, compile_writer(f.type))
+        for f in t.fields
+    ]
+
+
+def encode_record_batch(
+    batch: pa.RecordBatch, t: Record, plan: List[tuple] = None
+) -> List[bytes]:
     """Encode every row of ``batch`` as one Avro datum
     (≙ ``serialization_containers::serialize``, ``:13-22``).
 
     Columns are matched by name; a missing column is an error
     (``:248-267``). Extra columns in the batch are ignored.
     """
-    if not isinstance(t, Record):
-        raise ValueError("top-level Avro schema must be a record")
+    if plan is None:
+        plan = compile_encoder_plan(t)
     n = batch.num_rows
     cols = []
-    for f in t.fields:
-        idx = batch.schema.get_field_index(f.name)
+    for name, expected_type, ftype, writer in plan:
+        idx = batch.schema.get_field_index(name)
         if idx == -1:
             raise ValueError(
-                f"record batch is missing column {f.name!r} required by schema"
+                f"record batch is missing column {name!r} required by schema"
             )
-        expected = to_arrow_field(f.type, name=f.name, nullable=False)
         actual = batch.schema.field(idx).type
-        if not _types_compatible(actual, expected.type):
+        if not _types_compatible(actual, expected_type):
             raise ValueError(
-                f"column {f.name!r} has Arrow type {actual}, but the Avro "
-                f"schema requires {expected.type}"
+                f"column {name!r} has Arrow type {actual}, but the Avro "
+                f"schema requires {expected_type}"
             )
-        cols.append((f.name, extract_rows(batch.column(idx), f.type),
-                     compile_writer(f.type)))
+        cols.append((name, extract_rows(batch.column(idx), ftype), writer))
     out: List[bytes] = []
     for i in range(n):
         buf = bytearray()
