@@ -104,6 +104,22 @@ def _device_codec(entry: SchemaEntry, backend: str):
         return None
 
 
+_device_encode_spec = None
+
+
+def _device_encode_available() -> bool:
+    """True when ``ops.encode`` exists (checked once, without importing
+    JAX or building any codec)."""
+    global _device_encode_spec
+    if _device_encode_spec is None:
+        import importlib.util
+
+        _device_encode_spec = (
+            importlib.util.find_spec("pyruhvro_tpu.ops.encode") is not None,
+        )
+    return _device_encode_spec[0]
+
+
 def _host_reader(entry: SchemaEntry):
     """Per-schema memoized fallback wire reader (compile once, use on every
     call/chunk — the host analogue of the schema→kernel cache)."""
@@ -177,7 +193,16 @@ def serialize_record_batch(
             else pa.RecordBatch.from_pylist([], schema=batch.schema)
         )
     bounds = chunk_bounds(batch.num_rows, num_chunks)
-    codec = _device_codec(entry, backend)
+    # availability of the encode kernel is decided before constructing the
+    # (decode-lowering + backend-probing) device codec, so serialize-only
+    # workloads in a host-only build never pay for it
+    codec = None
+    if _device_encode_available():
+        codec = _device_codec(entry, backend)
+    elif backend == "tpu":
+        raise RuntimeError(
+            "the device encode kernel is not available in this build"
+        )
     if codec is not None:
         return [codec.encode(batch.slice(a, b - a)) for a, b in bounds]
     ir = entry.ir

@@ -27,6 +27,7 @@ import decimal
 import uuid as _uuid
 from typing import Callable, List, Sequence
 
+import numpy as np
 import pyarrow as pa
 
 from ..schema.model import (
@@ -261,39 +262,60 @@ def _build_array(t: AvroType, dt: pa.DataType, values: List[object]) -> pa.Array
 
     if isinstance(t, Array):
         item_field = dt.value_field
+        # null rows repeat the previous offset and set a validity bit; a null
+        # in the offsets array itself would mark the WRONG row (the from_arrays
+        # null-offset convention applies to the start position, which is the
+        # previous row's end)
         offsets = [0]
+        validity = []
         child_values = []
         n = 0
         for v in values:
             if v is None:
-                offsets.append(None)
+                validity.append(False)
             else:
                 child_values.extend(v)
                 n += len(v)
-                offsets.append(n)
+                validity.append(True)
+            offsets.append(n)
         child = _build_array(t.items, item_field.type, child_values)
+        mask = pa.array([not ok for ok in validity]) if not all(validity) else None
         return pa.ListArray.from_arrays(
-            pa.array(offsets, pa.int32()), child, type=dt
+            pa.array(offsets, pa.int32()), child, type=dt, mask=mask
         )
 
     if isinstance(t, Map):
         offsets = [0]
+        validity = []
         keys: List[object] = []
         vals: List[object] = []
         n = 0
         for v in values:
             if v is None:
-                offsets.append(None)
+                validity.append(False)
             else:
                 for k, item in v:
                     keys.append(k)
                     vals.append(item)
                 n += len(v)
-                offsets.append(n)
+                validity.append(True)
+            offsets.append(n)
         key_arr = pa.array(keys, pa.string())
         val_arr = _build_array(t.values, dt.item_type, vals)
-        return pa.MapArray.from_arrays(
-            pa.array(offsets, pa.int32()), key_arr, val_arr, type=dt
+        entries = pa.StructArray.from_arrays(
+            [key_arr, val_arr], fields=[dt.key_field, dt.item_field]
+        )
+        if all(validity):
+            vbuf, nulls = None, 0
+        else:
+            vbuf = pa.py_buffer(
+                np.packbits(np.array(validity, bool), bitorder="little")
+            )
+            nulls = validity.count(False)
+        return pa.Array.from_buffers(
+            dt, len(values),
+            [vbuf, pa.py_buffer(np.array(offsets, np.int32))],
+            null_count=nulls, children=[entries],
         )
 
     if isinstance(t, Union):
@@ -322,6 +344,12 @@ def _build_array(t: AvroType, dt: pa.DataType, values: List[object]) -> pa.Array
     if isinstance(t, Record):
         validity = [v is not None for v in values]
         any_null = not all(validity)
+        if not t.fields:
+            # StructArray.from_arrays([]) would be length 0 regardless of
+            # len(values); build the empty-struct rows explicitly
+            return pa.array(
+                [None if v is None else {} for v in values], pa.struct([])
+            )
         children = []
         fields = []
         for i, f in enumerate(t.fields):

@@ -1,0 +1,120 @@
+"""Device codec: the object ``api.py`` routes to behind the fast gate.
+
+``get_device_codec(entry)`` is the TPU analogue of the reference's gate
+target (``fast_decode::decode_with_arrow_schema``,
+``ruhvro/src/fast_decode.rs:815``): construction performs the one-time
+schema lowering + backend probe, memoized on the ``SchemaEntry`` so a
+schema string maps to its compiled kernels for the process lifetime
+(≙ the schema cache + shared-Arc amortization, ``src/lib.rs:35-54``,
+``deserialize.rs:83-89``).
+
+Raises :class:`UnsupportedOnDevice` for schemas outside the device
+subset (silent host fallback in ``backend='auto'``, like
+``deserialize.rs:26-29``); any other exception means the backend itself
+is broken and is surfaced by ``api.py``.
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence
+
+import pyarrow as pa
+
+from ..schema.cache import SchemaEntry
+from . import UnsupportedOnDevice
+from .decode import DeviceDecoder
+
+__all__ = ["DeviceCodec", "get_device_codec"]
+
+_PROBE_TIMEOUT_S = float(__import__("os").environ.get(
+    "PYRUHVRO_TPU_PROBE_TIMEOUT", "60"))
+_probe_result: list = []  # memoized: [devices] or [exception]
+
+
+def _probe_backend() -> None:
+    """Initialize the JAX backend once, with a timeout.
+
+    Backend init can hang (not fail) when a device transport is wedged;
+    running it on a watchdog thread turns that hang into a RuntimeError so
+    ``backend='auto'`` degrades to the host path with a warning instead of
+    blocking the caller indefinitely."""
+    import threading
+
+    if _probe_result:
+        out = _probe_result[0]
+        if isinstance(out, BaseException):
+            raise RuntimeError(f"JAX backend unavailable: {out!r}") from out
+        return
+
+    def run():
+        try:
+            import jax
+
+            _probe_result.append(jax.devices())
+        except BaseException as e:  # noqa: BLE001 — reported to caller
+            _probe_result.append(e)
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    t.join(_PROBE_TIMEOUT_S)
+    if not _probe_result:
+        e = TimeoutError(
+            f"JAX backend initialization did not finish within "
+            f"{_PROBE_TIMEOUT_S:.0f}s (wedged device transport?)"
+        )
+        _probe_result.append(e)
+    out = _probe_result[0]
+    if isinstance(out, BaseException):
+        raise RuntimeError(f"JAX backend unavailable: {out!r}") from out
+
+
+class DeviceCodec:
+    """Schema-bound decode/encode pipelines on the default JAX backend."""
+
+    def __init__(self, entry: SchemaEntry):
+        self.entry = entry
+        self.ir = entry.ir
+        self.arrow_schema = entry.arrow_schema
+        self.decoder = DeviceDecoder(entry.ir)
+        self._encoder = None
+        # probe the backend now: a missing/broken device must fail at
+        # construction (where api.py distinguishes it from unsupported
+        # schemas), not on the first decode call. The probe is
+        # time-bounded: a wedged device transport must degrade to the
+        # host path, not hang every backend='auto' caller forever.
+        _probe_backend()
+
+    def decode(self, data: Sequence[bytes]) -> pa.RecordBatch:
+        if len(data) == 0:
+            # empty launch has no shapes to compile; build directly
+            from ..fallback.decoder import decode_to_record_batch
+
+            return decode_to_record_batch([], self.ir, self.arrow_schema)
+        from .decode import DeviceCapacityExceeded
+
+        try:
+            host, n, meta = self.decoder.decode_to_columns(data)
+        except DeviceCapacityExceeded:
+            # a batch whose per-record item counts exceed device capacity
+            # is still valid Avro: serve it from the general path (the
+            # same degradation the reference applies to unsupported
+            # schemas, deserialize.rs:26-29 — here per batch)
+            from ..fallback.decoder import decode_to_record_batch
+
+            return decode_to_record_batch(data, self.ir, self.arrow_schema)
+        from .arrow_build import build_record_batch
+
+        return build_record_batch(self.ir, self.arrow_schema, host, n, meta)
+
+    def encode(self, batch: pa.RecordBatch) -> pa.Array:
+        if self._encoder is None:
+            from .encode import DeviceEncoder
+
+            self._encoder = DeviceEncoder(self.ir, self.arrow_schema)
+        return self._encoder.encode(batch)
+
+
+def get_device_codec(entry: SchemaEntry) -> DeviceCodec:
+    """Memoized per-schema codec (≙ ``get_or_parse_schema`` + the Arc-shared
+    Arrow schema, ``src/lib.rs:44``/``deserialize.rs:85-89``)."""
+    return entry.get_extra("device_codec", lambda: DeviceCodec(entry))

@@ -1,0 +1,350 @@
+"""Jitted decode pipeline: pack → (walk + finalize, one launch) → host.
+
+Per batch (SURVEY.md §7's two-pass size-then-scatter, organized for XLA
+and for a high-latency host↔device interconnect):
+
+1. host packs the datums dense (``concat_records``, C++ shim) and ships
+   ONE flat byte buffer + per-record offsets,
+2. one fused jit launch runs the lowered field program (the **walk**:
+   numeric lanes, validity bytes, type ids, item counts, string
+   ``(start, len)`` descriptors) and the **finalize** (prefix-sum
+   offsets, compaction of strided item slots) and concatenates every
+   output plus the data-dependent reductions into ONE uint8 blob,
+3. one device→host transfer fetches the blob; the host splits it by the
+   statically known layout and assembles pyarrow arrays
+   (``arrow_build``) — string value bytes are gathered host-side from
+   the host's own copy of the input and never cross the interconnect.
+
+Variable-size outputs get **speculative static capacities**: item-slot
+caps and per-region item totals are remembered per schema from previous
+batches; when a batch exceeds them the launch is retried with bigger
+(power-of-two bucketed) caps. Steady-state workloads therefore run
+exactly one launch + one transfer and compile exactly once per
+(schema, R, B) bucket (≙ the schema→kernel cache, SURVEY.md §2 row 5).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Sequence, Tuple
+
+import numpy as np
+
+from ..fallback.io import MalformedAvro
+from ..runtime.pack import bucket_len, concat_records
+from .fieldprog import ROWS, Program, lower
+from .varint import ERR_ITEM_OVERFLOW, ERR_NAMES
+
+__all__ = ["DeviceDecoder", "DeviceCapacityExceeded"]
+
+_DEFAULT_ITEM_CAP = 8
+_DEFAULT_TOT_CAP = 8
+# per-record item-slot ceiling: beyond this the strided buffers would not
+# fit device memory; the codec falls back to the host path for the batch
+_MAX_ITEM_CAP = 1 << 20
+_cache_enabled = False
+
+
+class DeviceCapacityExceeded(Exception):
+    """Batch needs more per-record item slots than the device path
+    supports; the caller decodes it on the host instead."""
+
+
+def _enable_persistent_cache(jax) -> None:
+    """Point XLA's persistent compilation cache at a user-cache dir (unless
+    the user configured one), so each (schema, shape-bucket) kernel
+    compiles once per machine instead of once per process. Disable with
+    PYRUHVRO_TPU_NO_CACHE=1."""
+    global _cache_enabled
+    if _cache_enabled:
+        return
+    _cache_enabled = True
+    import os
+
+    if os.environ.get("PYRUHVRO_TPU_NO_CACHE"):
+        return
+    try:
+        # CPU executables AOT-reload with machine-feature mismatches (XLA
+        # warns about SIGILL); only accelerator backends cache safely.
+        # Decide from the *configured* platform string — asking the backend
+        # (jax.default_backend()) would initialize it, and a wedged device
+        # plugin can block that indefinitely.
+        plats = jax.config.jax_platforms or os.environ.get("JAX_PLATFORMS", "")
+        first = plats.split(",")[0].strip().lower()
+        if first in ("", "cpu"):
+            return
+        if jax.config.jax_compilation_cache_dir is None:
+            jax.config.update(
+                "jax_compilation_cache_dir",
+                os.path.expanduser("~/.cache/pyruhvro_tpu/xla"),
+            )
+            jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+            jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+    except Exception:  # cache is an optimization; never fail construction
+        pass
+
+
+class DeviceDecoder:
+    """Per-schema decode pipeline with compiled-kernel caches."""
+
+    def __init__(self, ir, backend: str = None):
+        import jax  # deferred: importing pyruhvro_tpu must stay JAX-free
+
+        _enable_persistent_cache(jax)
+        self._jax = jax
+        self.prog: Program = lower(ir)
+        self.backend = backend
+        self._pipe_cache: Dict[tuple, tuple] = {}
+        self._err_cache: Dict[tuple, object] = {}
+        self._item_caps: List[int] = [0] + [
+            _DEFAULT_ITEM_CAP for _ in self.prog.regions[1:]
+        ]
+        # per-region item-total caps, remembered per R bucket
+        self._tot_cap_mem: Dict[Tuple[int, int], int] = {}
+        self._lock = threading.Lock()
+
+    # -- traced pieces -----------------------------------------------------
+
+    def _trace_walk(self, R: int, item_caps, words, starts, lengths, n):
+        jnp = self._jax.numpy
+        prog = self.prog
+        from .fieldprog import _Ctx
+        from .varint import ERR_TRAILING
+
+        def cap_of(region: int) -> int:
+            return R if region == ROWS else R * item_caps[region]
+
+        row = jnp.arange(R, dtype=jnp.int32)
+        st = {"#cursor": starts, "#err": jnp.zeros(R, jnp.uint32)}
+        for spec in prog.buffers.values():
+            st[spec.key] = jnp.zeros(cap_of(spec.region), spec.dtype)
+        ends = starts + lengths
+        active = row < n
+        cx = _Ctx(words, ends, item_caps)
+        st = prog.emit(cx, st, active, None)
+        st["#err"] = st["#err"] | jnp.where(
+            active & (st["#cursor"] != ends),
+            jnp.uint32(ERR_TRAILING),
+            jnp.uint32(0),
+        )
+        return st
+
+    # -- the fused pipeline ------------------------------------------------
+
+    def _pipeline_fn(self, R: int, B: int, item_caps: Tuple[int, ...],
+                     tot_caps: Tuple[int, ...]):
+        """Compiled fused walk+finalize. Returns ``(fn, layout)`` where
+        ``fn(words, starts, lengths, n)`` yields ONE uint8 blob and
+        ``layout`` is ``[(key, dtype, length), ...]`` for the host split.
+        The blob also carries the reductions (error flag, per-region item
+        max/sum) so the steady state costs a single device round trip."""
+        key = (R, B, item_caps, tot_caps)
+        hit = self._pipe_cache.get(key)
+        if hit is not None:
+            return hit
+        jax = self._jax
+        jnp = jax.numpy
+        lax = jax.lax
+        prog = self.prog
+
+        item_buffers = {
+            rid: sorted(
+                (s for s in prog.buffers.values() if s.region == rid),
+                key=lambda s: s.key,
+            )
+            for rid in range(1, len(prog.regions))
+        }
+
+        def row_of(offsets, n_entries: int, cap: int):
+            """For each position j < cap, the entry whose [offsets[i],
+            offsets[i+1]) range contains j — one scatter-max + one cummax
+            scan instead of a per-position binary search."""
+            m = jnp.zeros(cap, jnp.int32)
+            m = m.at[offsets[:n_entries]].max(
+                jnp.arange(n_entries, dtype=jnp.int32), mode="drop"
+            )
+            return lax.cummax(m)
+
+        def pipeline(words, starts, lengths, n):
+            st = self._trace_walk(R, item_caps, words, starts, lengths, n)
+            out = {}
+            for rid in range(1, len(prog.regions)):
+                path = prog.regions[rid]
+                icap, tcap = item_caps[rid], tot_caps[rid]
+                counts = st[path + "#count"]
+                offsets = jnp.concatenate(
+                    [jnp.zeros(1, jnp.int32),
+                     jnp.cumsum(counts, dtype=jnp.int32)]
+                )
+                out[path + "#offsets"] = offsets
+                j = jnp.arange(tcap, dtype=jnp.int32)
+                row = row_of(offsets, R, tcap)
+                slot = row * icap + (j - jnp.take(offsets, row, mode="clip"))
+                # entries past the region's true total are zeroed — their
+                # lens feed host-side cumsums
+                in_range = j < offsets[-1]
+                for spec in item_buffers[rid]:
+                    taken = jnp.take(st[spec.key], slot, mode="clip")
+                    out[spec.key] = jnp.where(in_range, taken,
+                                              jnp.zeros_like(taken))
+                out["#red:max:" + path] = jnp.max(counts).reshape(1)
+                out["#red:sum:" + path] = offsets[-1].reshape(1)
+            for spec in prog.buffers.values():
+                if spec.region == ROWS and spec.key.rpartition("#")[2] != "count":
+                    out[spec.key] = st[spec.key]
+            out["#red:err"] = (
+                jnp.any((st["#err"] & ~jnp.uint32(ERR_ITEM_OVERFLOW)) != 0)
+                .reshape(1)
+                .astype(jnp.uint8)
+            )
+            # one blob, one transfer
+            chunks = []
+            for k in sorted(out):
+                v = out[k]
+                if v.dtype == jnp.uint8:
+                    chunks.append(v)
+                else:
+                    chunks.append(
+                        lax.bitcast_convert_type(v, jnp.uint8).reshape(-1)
+                    )
+            return jnp.concatenate(chunks)
+
+        # the blob layout mirrors pipeline's sorted(out) order exactly
+        sizes: Dict[str, tuple] = {}
+        for rid in range(1, len(prog.regions)):
+            path = prog.regions[rid]
+            sizes[path + "#offsets"] = (np.int32, R + 1)
+            for spec in item_buffers[rid]:
+                sizes[spec.key] = (np.dtype(spec.dtype), tot_caps[rid])
+            sizes["#red:max:" + path] = (np.int32, 1)
+            sizes["#red:sum:" + path] = (np.int32, 1)
+        for spec in prog.buffers.values():
+            if spec.region == ROWS and spec.key.rpartition("#")[2] != "count":
+                sizes[spec.key] = (np.dtype(spec.dtype), R)
+        sizes["#red:err"] = (np.uint8, 1)
+        layout = [(k,) + sizes[k] for k in sorted(sizes)]
+
+        pair = (jax.jit(pipeline), layout)
+        with self._lock:
+            self._pipe_cache[key] = pair
+        return pair
+
+    def _err_fn(self, R: int, B: int, item_caps: Tuple[int, ...]):
+        """Walk-only error lanes, compiled lazily — only a malformed batch
+        ever pays for it."""
+        key = (R, B, item_caps)
+        fn = self._err_cache.get(key)
+        if fn is None:
+            fn = self._jax.jit(
+                lambda words, starts, lengths, n: self._trace_walk(
+                    R, item_caps, words, starts, lengths, n
+                )["#err"]
+            )
+            with self._lock:
+                self._err_cache[key] = fn
+        return fn
+
+    # -- orchestration -----------------------------------------------------
+
+    def decode_to_columns(self, data: Sequence[bytes]):
+        """Run the pipeline; returns ``(host_columns, n, meta)`` where meta
+        carries per-region item totals and the raw datum bytes for the
+        host-side assembly."""
+        jax = self._jax
+        n = len(data)
+        flat, offsets = concat_records(data)
+        total = int(offsets[-1])
+        if total > (1 << 30):
+            # int32 cursors: callers split giant batches (runtime/chunking)
+            raise ValueError(
+                "batch exceeds 1 GiB of datum bytes; split it into chunks"
+            )
+        B = bucket_len(max(total, 4), minimum=16)
+        R = bucket_len(max(n, 1), minimum=8)
+        if B != total:
+            flat = np.concatenate([flat, np.zeros(B - total, np.uint8)])
+        words = np.ascontiguousarray(flat).view(np.uint32)
+        starts = np.full(R, B, np.int32)
+        starts[:n] = offsets[:-1]
+        lengths = np.zeros(R, np.int32)
+        lengths[:n] = np.diff(offsets)
+
+        words_d = jax.device_put(words)
+        starts_d = jax.device_put(starts)
+        lengths_d = jax.device_put(lengths)
+        n_d = np.int32(n)
+
+        prog = self.prog
+        host = None
+        # zero-byte items (null / empty-record) reveal their true count only
+        # ~cap-at-a-time, so cap growth can take ~log2(_MAX_ITEM_CAP) rounds
+        for _attempt in range(24):
+            item_caps = tuple(self._item_caps)
+            tot_caps = tuple(
+                [0]
+                + [
+                    min(
+                        self._tot_cap_mem.get((R, rid), _DEFAULT_TOT_CAP),
+                        R * item_caps[rid],
+                    )
+                    for rid in range(1, len(prog.regions))
+                ]
+            )
+            fn, layout = self._pipeline_fn(R, B, item_caps, tot_caps)
+            blob = np.asarray(
+                jax.device_get(fn(words_d, starts_d, lengths_d, n_d))
+            )
+            host = {}
+            pos = 0
+            for key, dt, ln in layout:
+                nbytes = np.dtype(dt).itemsize * ln
+                host[key] = blob[pos : pos + nbytes].view(dt)
+                pos += nbytes
+            assert pos == blob.nbytes, "pipeline layout mismatch"
+            retry = False
+            for rid, path in enumerate(prog.regions):
+                if rid == ROWS:
+                    continue
+                maxc = int(host["#red:max:" + path][0])
+                sumc = int(host["#red:sum:" + path][0])
+                if maxc > item_caps[rid]:
+                    if maxc > _MAX_ITEM_CAP:
+                        raise DeviceCapacityExceeded(
+                            f"{path!r} needs {maxc} item slots per record "
+                            f"(device limit {_MAX_ITEM_CAP})"
+                        )
+                    self._item_caps[rid] = bucket_len(
+                        maxc, minimum=_DEFAULT_ITEM_CAP
+                    )
+                    retry = True
+                if sumc > tot_caps[rid]:
+                    self._tot_cap_mem[(R, rid)] = bucket_len(
+                        max(sumc, 1), minimum=_DEFAULT_TOT_CAP
+                    )
+                    retry = True
+            if not retry:
+                break
+        else:
+            raise MalformedAvro("array/map item capacity did not converge")
+
+        if host["#red:err"][0]:
+            err = np.asarray(
+                jax.device_get(
+                    self._err_fn(R, B, item_caps)(
+                        words_d, starts_d, lengths_d, n_d
+                    )
+                )
+            )[:n]
+            bad = err & ~np.uint32(ERR_ITEM_OVERFLOW)
+            i = int(np.flatnonzero(bad)[0])
+            v = int(bad[i])
+            bit = v & -v
+            raise MalformedAvro(
+                f"record {i}: {ERR_NAMES.get(bit, f'error bit {bit:#x}')}"
+            )
+
+        meta = {"item_totals": {}, "flat": flat}
+        for rid, path in enumerate(prog.regions):
+            if rid != ROWS:
+                meta["item_totals"][path] = int(host["#red:sum:" + path][0])
+        return host, n, meta

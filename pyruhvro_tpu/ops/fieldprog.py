@@ -1,0 +1,464 @@
+"""Schema IR → static field program (the device decode lowering).
+
+The reference walks each record with a tree of boxed per-field decoders
+driven by runtime dispatch (``FieldDecoder`` ``ruhvro/src/fast_decode.rs:67-420``).
+On TPU there is no cheap per-row dispatch — instead the schema is lowered
+**once** into a static program of vectorized steps, unrolled at JAX trace
+time: each step decodes one schema position for *all records at once*
+(one lane per record), masks composing nullable branches, union arms and
+array blocks. Data-dependent control flow exists only where the wire
+format forces it — the array/map block protocol — as a single
+``lax.while_loop`` whose body decodes one item per active lane
+(≙ ``read_block_count`` semantics, ``fast_decode.rs:689-700``).
+
+Output layout (the "column specs"):
+
+* every leaf writes fixed-size device buffers keyed by a path string
+  (``"address/street#start"``); ``#``-suffixed buffer names cannot clash
+  with Avro identifiers,
+* repeated fields (array/map) write items into **strided slots**
+  ``row * item_cap + i`` of a separate *region*; a too-small statically
+  chosen ``item_cap`` is detected per lane (ERR_ITEM_OVERFLOW) and the
+  host retries with a bigger cap — see ``ops/decode.py``,
+* variable-width bytes (string values) are not moved during the walk at
+  all: the walk records ``(start, len)`` only, and the finalize pass
+  (``ops/decode.py``) gathers value bytes once sizes are known.
+
+Device subset = the reference's fast subset (``fast_decode.rs:38-61``)
+minus nested repetition (an array/map anywhere inside another array/map's
+items raises :class:`UnsupportedOnDevice` → silent host fallback in
+``backend='auto'``, the same degradation the reference applies to
+unsupported schemas).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Tuple
+
+import jax.numpy as jnp
+from jax import lax
+
+from . import UnsupportedOnDevice
+from .varint import (
+    ERR_BAD_BRANCH,
+    ERR_BAD_ENUM,
+    ERR_ITEM_OVERFLOW,
+    ERR_NEG_LEN,
+    ERR_OVERRUN,
+    ERR_TRAILING,
+    U32,
+    read_bool_byte,
+    read_f32,
+    read_f64_pair,
+    read_f64_pair as _read_f64_pair,
+    read_varint32,
+    read_varint64,
+    zigzag_decode_pair,
+)
+from ..gate import is_supported
+from ..schema.model import (
+    Array,
+    AvroType,
+    Enum,
+    Map,
+    Primitive,
+    Record,
+    RecordField,
+    Union,
+)
+
+__all__ = ["Program", "lower", "ROWS"]
+
+ROWS = 0  # region id of the per-row region
+_BIG = 1 << 30  # out-of-range scatter index → dropped (mode="drop")
+I32 = jnp.int32
+
+
+@dataclass
+class BufSpec:
+    key: str
+    dtype: object  # jnp dtype
+    region: int
+
+
+@dataclass
+class StringCol:
+    """A string-valued column whose bytes are gathered in finalize."""
+
+    path: str          # buffers at path#start / path#len
+    region: int
+
+
+@dataclass
+class Program:
+    """Lowered, schema-static decode program."""
+
+    ir: Record
+    buffers: Dict[str, BufSpec]
+    regions: List[str]          # region id → path of the repeated field ("" = rows)
+    string_cols: List[StringCol]
+    emit: Callable              # emit(cx, st, mask, out_idx) -> st  (top record)
+
+    def region_of(self, path: str) -> int:
+        return self.buffers[path + "#count"].region
+
+
+class _Ctx:
+    """Runtime (traced) values threaded through emitters."""
+
+    __slots__ = ("words", "ends", "item_caps")
+
+    def __init__(self, words, ends, item_caps: Tuple[int, ...]):
+        self.words = words
+        self.ends = ends          # absolute end index per row lane
+        self.item_caps = item_caps  # static cap per region (item_caps[0] unused)
+
+
+def _put(st, key, idx, val, mask):
+    """Masked write of one lane-vector into a column buffer.
+
+    ``idx=None`` means the writes are lane-aligned (row region, one slot
+    per lane) and lower to a select — XLA compiles piles of selects far
+    faster than piles of scatters, and every top-level field write is one.
+    Item-region writes (strided slots) are true masked scatters."""
+    buf = st[key]
+    if idx is None:
+        st[key] = jnp.where(mask, val.astype(buf.dtype), buf)
+    else:
+        safe = jnp.where(mask, idx, I32(_BIG))
+        st[key] = buf.at[safe].set(val.astype(buf.dtype), mode="drop")
+    return st
+
+
+def _acc_err(st, bits):
+    st["#err"] = st["#err"] | bits
+    return st
+
+
+def _err_where(st, mask, bit):
+    return _acc_err(st, jnp.where(mask, jnp.uint32(bit), jnp.uint32(0)))
+
+
+class _Lowering:
+    def __init__(self) -> None:
+        self.buffers: Dict[str, BufSpec] = {}
+        self.regions: List[str] = [""]
+        self.string_cols: List[StringCol] = []
+
+    def buf(self, key: str, dtype, region: int) -> None:
+        self.buffers[key] = BufSpec(key, dtype, region)
+
+    # -- emitters ---------------------------------------------------------
+
+    def lower_type(self, t: AvroType, path: str, region: int) -> Callable:
+        """Return ``emit(cx, st, mask, out_idx) -> st`` for one value of
+        ``t`` at ``path``, registering its output buffers."""
+        if isinstance(t, Primitive):
+            return self.lower_primitive(t, path, region)
+        if isinstance(t, Enum):
+            return self.lower_enum(t, path, region)
+        if isinstance(t, Record):
+            return self.lower_record(t, path, region)
+        if isinstance(t, Union):
+            if t.is_nullable_pair:
+                return self.lower_nullable(t, path, region)
+            return self.lower_union(t, path, region)
+        if isinstance(t, (Array, Map)):
+            if region != ROWS:
+                raise UnsupportedOnDevice(
+                    f"nested repetition at {path!r} (array/map inside "
+                    f"array/map items) is outside the device subset"
+                )
+            return self.lower_repeated(t, path)
+        raise UnsupportedOnDevice(f"type {type(t).__name__} at {path!r}")
+
+    def lower_primitive(self, t: Primitive, path: str, region: int) -> Callable:
+        name = t.name
+        if name == "null":
+            return lambda cx, st, mask, out_idx: st
+
+        if name in ("int", "long"):
+            wide = name == "long"
+            if wide:
+                self.buf(path + "#lo", U32, region)
+                self.buf(path + "#hi", U32, region)
+            else:
+                self.buf(path + "#v", I32, region)
+
+            def emit_varint(cx, st, mask, out_idx):
+                lo, hi, cur, verr = read_varint64(cx.words, st["#cursor"], mask)
+                lo, hi = zigzag_decode_pair(lo, hi)
+                st["#cursor"] = cur
+                st = _acc_err(st, verr)
+                if wide:
+                    st = _put(st, path + "#lo", out_idx, lo, mask)
+                    st = _put(st, path + "#hi", out_idx, hi, mask)
+                else:
+                    st = _put(st, path + "#v", out_idx, lo.astype(I32), mask)
+                return st
+
+            return emit_varint
+
+        if name == "float":
+            self.buf(path + "#v", jnp.float32, region)
+
+            def emit_f32(cx, st, mask, out_idx):
+                v, cur = read_f32(cx.words, st["#cursor"], mask)
+                st["#cursor"] = cur
+                return _put(st, path + "#v", out_idx, v, mask)
+
+            return emit_f32
+
+        if name == "double":
+            self.buf(path + "#lo", U32, region)
+            self.buf(path + "#hi", U32, region)
+
+            def emit_f64(cx, st, mask, out_idx):
+                lo, hi, cur = _read_f64_pair(cx.words, st["#cursor"], mask)
+                st["#cursor"] = cur
+                st = _put(st, path + "#lo", out_idx, lo, mask)
+                return _put(st, path + "#hi", out_idx, hi, mask)
+
+            return emit_f64
+
+        if name == "boolean":
+            self.buf(path + "#v", jnp.uint8, region)
+
+            def emit_bool(cx, st, mask, out_idx):
+                b, cur, berr = read_bool_byte(cx.words, st["#cursor"], mask)
+                st["#cursor"] = cur
+                st = _acc_err(st, berr)
+                return _put(st, path + "#v", out_idx, b, mask)
+
+            return emit_bool
+
+        if name == "string":
+            self.buf(path + "#start", I32, region)
+            self.buf(path + "#len", I32, region)
+            self.string_cols.append(StringCol(path, region))
+
+            def emit_string(cx, st, mask, out_idx):
+                lo, hi, cur, verr = read_varint32(cx.words, st["#cursor"], mask)
+                lo, hi = zigzag_decode_pair(lo, hi)
+                slen = lo.astype(I32)
+                bad = mask & ((slen < 0) | (hi != 0))
+                st = _acc_err(st, verr)
+                st = _err_where(st, bad, ERR_NEG_LEN)
+                slen = jnp.where(bad, 0, slen)
+                new_cur = cur + jnp.where(mask, slen, 0)
+                st = _err_where(st, mask & (new_cur > cx.ends), ERR_OVERRUN)
+                st = _put(st, path + "#start", out_idx, cur, mask)
+                st = _put(st, path + "#len", out_idx, slen, mask)
+                st["#cursor"] = new_cur
+                return st
+
+            return emit_string
+
+        raise UnsupportedOnDevice(f"primitive {name!r} at {path!r}")
+
+    def lower_enum(self, t: Enum, path: str, region: int) -> Callable:
+        self.buf(path + "#v", I32, region)
+        n = len(t.symbols)
+
+        def emit_enum(cx, st, mask, out_idx):
+            lo, hi, cur, verr = read_varint32(cx.words, st["#cursor"], mask)
+            lo, hi = zigzag_decode_pair(lo, hi)
+            idx = lo.astype(I32)
+            st["#cursor"] = cur
+            st = _acc_err(st, verr)
+            st = _err_where(
+                st, mask & ((hi != 0) | (idx < 0) | (idx >= n)), ERR_BAD_ENUM
+            )
+            return _put(st, path + "#v", out_idx, idx, mask)
+
+        return emit_enum
+
+    def lower_record(self, t: Record, path: str, region: int) -> Callable:
+        prefix = path + "/" if path else ""
+        emitters = [
+            self.lower_type(f.type, prefix + f.name, region) for f in t.fields
+        ]
+
+        def emit_record(cx, st, mask, out_idx):
+            for e in emitters:
+                st = e(cx, st, mask, out_idx)
+            return st
+
+        return emit_record
+
+    def _read_branch(self, cx, st, mask):
+        """Read a small non-negative varint (union branch). Any value with
+        a nonzero high word is out of range for every caller — reject it
+        rather than silently truncating to the low 32 bits."""
+        lo, hi, cur, verr = read_varint32(cx.words, st["#cursor"], mask)
+        lo, hi = zigzag_decode_pair(lo, hi)
+        st["#cursor"] = cur
+        st = _acc_err(st, verr)
+        st = _err_where(st, mask & (hi != 0), ERR_BAD_BRANCH)
+        return lo.astype(I32), st
+
+    def lower_nullable(self, t: Union, path: str, region: int) -> Callable:
+        """2-variant ``["null", T]`` union → validity bitmap + masked inner
+        decode (≙ ``make_nullable_decoder``, ``fast_decode.rs:270``)."""
+        self.buf(path + "#valid", jnp.uint8, region)
+        null_idx = t.null_index
+        inner = self.lower_type(t.non_null_variant, path, region)
+
+        def emit_nullable(cx, st, mask, out_idx):
+            branch, st = self._read_branch(cx, st, mask)
+            present = mask & (branch == (1 - null_idx))
+            absent = mask & (branch == null_idx)
+            st = _err_where(st, mask & ~(present | absent), ERR_BAD_BRANCH)
+            st = _put(st, path + "#valid", out_idx,
+                      jnp.full_like(branch, 1, dtype=jnp.uint8), present)
+            return inner(cx, st, present, out_idx)
+
+        return emit_nullable
+
+    def lower_union(self, t: Union, path: str, region: int) -> Callable:
+        """N-variant sparse union → type_ids + per-arm masked decode
+        (≙ ``UnionDecoder``, ``fast_decode.rs:642-684``)."""
+        self.buf(path + "#tid", I32, region)
+        n = len(t.variants)
+        arms: List[Optional[Callable]] = []
+        for k, v in enumerate(t.variants):
+            if v.is_null():
+                arms.append(None)
+            else:
+                arms.append(self.lower_type(v, f"{path}/{k}", region))
+
+        def emit_union(cx, st, mask, out_idx):
+            branch, st = self._read_branch(cx, st, mask)
+            st = _err_where(st, mask & ((branch < 0) | (branch >= n)),
+                            ERR_BAD_BRANCH)
+            st = _put(st, path + "#tid", out_idx, branch, mask)
+            for k, arm in enumerate(arms):
+                if arm is not None:
+                    st = arm(cx, st, mask & (branch == k), out_idx)
+            return st
+
+        return emit_union
+
+    def lower_repeated(self, t, path: str) -> Callable:
+        """Array/map block protocol as one vectorized ``lax.while_loop``:
+        each iteration reads pending block headers and decodes at most one
+        item per active lane into strided slots ``row * item_cap + i``.
+        Negative block counts (item-count with byte-size prefix,
+        ``fast_decode.rs:689-700``) consume and discard the size."""
+        rid = len(self.regions)
+        self.regions.append(path)
+        self.buf(path + "#count", I32, ROWS)
+        if isinstance(t, Array):
+            item_emitters = [self.lower_type(t.items, path + "/@item", rid)]
+        else:  # Map: key string + value
+            item_emitters = [
+                self.lower_type(
+                    Primitive("string"), path + "/@key", rid
+                ),
+                self.lower_type(t.values, path + "/@val", rid),
+            ]
+
+        # only the buffers the loop writes travel in the while carry; the
+        # rest of the (large) state dict stays outside — this keeps the XLA
+        # loop body small, which dominates compile time
+        loop_keys = None
+
+        def emit_repeated(cx, st, mask, out_idx):
+            nonlocal loop_keys
+            if loop_keys is None:
+                loop_keys = sorted(
+                    k for k, s in self.buffers.items() if s.region == rid
+                ) + ["#cursor", "#err"]
+            icap = cx.item_caps[rid]
+            base = (
+                jnp.arange(st["#cursor"].shape[0], dtype=I32)
+                if out_idx is None
+                else out_idx
+            )
+            # worst-case legitimate iterations: one per wire byte of the
+            # longest row (headers and ≥1-byte items) plus one per item slot
+            # (zero-byte items: null/empty-record items consume no bytes,
+            # bounded by the per-record cap — an overflowing cap retries
+            # with a larger one, see ops/decode.py)
+            row_span = cx.ends - st["#cursor"]
+            max_iters = jnp.max(jnp.where(mask, row_span, 0)) + icap + 2
+
+            def cond(carry):
+                _st, _rem, done, _cnt, it = carry
+                return jnp.any(~done) & (it < max_iters)
+
+            def body(carry):
+                sub, rem, done, cnt, it = carry
+                st = dict(sub)  # item emitters only touch loop_keys
+                # 1) lanes needing a block header
+                need = (~done) & (rem == 0)
+                lo, hi, cur, verr = read_varint32(cx.words, st["#cursor"], need)
+                lo, hi = zigzag_decode_pair(lo, hi)
+                b = lo.astype(I32)
+                st = _acc_err(st, verr)
+                # a count whose high word is neither a zero- nor a
+                # sign-extension of the low word would truncate silently
+                bad_count = need & ~(
+                    ((hi == 0) & (b >= 0))
+                    | ((hi == jnp.uint32(0xFFFFFFFF)) & (b < 0))
+                )
+                st = _err_where(st, bad_count, ERR_OVERRUN)
+                b = jnp.where(bad_count, 0, b)
+                neg = need & (b < 0)
+                # negative count: a byte-size long follows; skip it
+                _slo, _shi, cur, serr = read_varint32(cx.words, cur, neg)
+                st = _acc_err(st, serr)
+                b = jnp.where(neg, -b, b)
+                st["#cursor"] = cur
+                ended = need & (b == 0)
+                done = done | ended
+                rem = jnp.where(need, jnp.where(ended, 0, b), rem)
+                st = _err_where(st, (~done) & (st["#cursor"] > cx.ends),
+                                ERR_OVERRUN)
+                done = done | ((~done) & (st["#cursor"] > cx.ends))
+                # 2) decode one item per lane that has items pending
+                can = (~done) & (rem > 0)
+                over = can & (cnt >= icap)
+                st = _err_where(st, over, ERR_ITEM_OVERFLOW)
+                # overflow lanes still *decode* (into dropped slots) so the
+                # cursor walk stays exact; the host retries with a larger cap
+                slot = jnp.where(cnt < icap, base * icap + cnt, I32(_BIG))
+                for e in item_emitters:
+                    st = e(cx, st, can, slot)
+                rem = rem - can.astype(I32)
+                cnt = cnt + can.astype(I32)
+                return {k: st[k] for k in loop_keys}, rem, done, cnt, it + 1
+
+            zero = jnp.zeros_like(st["#cursor"])
+            sub0 = {k: st[k] for k in loop_keys}
+            sub, _rem, done, cnt, it = lax.while_loop(
+                cond, body, (sub0, zero, ~mask, zero, I32(0))
+            )
+            st = dict(st)
+            st.update(sub)
+            # ran out of iterations with lanes still open → malformed
+            st = _err_where(st, ~done, ERR_OVERRUN)
+            return _put(st, path + "#count", out_idx, cnt, mask)
+
+        return emit_repeated
+
+
+def lower(ir: AvroType) -> Program:
+    """Lower a top-level record schema to its device field program.
+
+    Raises :class:`UnsupportedOnDevice` when outside the device subset
+    (which is the reference's fast subset, ``fast_decode.rs:38-61``,
+    minus nested repetition).
+    """
+    if not is_supported(ir):
+        raise UnsupportedOnDevice("schema is outside the fast-path subset")
+    lo = _Lowering()
+    emit = lo.lower_record(ir, "", ROWS)
+    return Program(
+        ir=ir,
+        buffers=lo.buffers,
+        regions=lo.regions,
+        string_cols=lo.string_cols,
+        emit=emit,
+    )

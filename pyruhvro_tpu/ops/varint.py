@@ -1,0 +1,186 @@
+"""Vectorized Avro wire-format primitives (JAX, int32-native).
+
+These are the TPU-first building blocks of the decode kernel: every
+helper operates on **vectors of per-record cursors** — one lane per
+record — so the inherently sequential byte walk of a single Avro datum
+(≙ ``read_zigzag_long`` ``ruhvro/src/fast_decode.rs:855-869``) becomes a
+data-parallel sweep across all records at once.
+
+Design rules (see /opt/skills/guides/pallas_guide.md and SURVEY.md §7):
+
+* All arithmetic is 32-bit. The TPU VPU lane is 32 bits wide and int64
+  is emulated; 64-bit quantities (Avro ``long``, ``double``) are carried
+  as ``(lo, hi)`` uint32 pairs and recombined on the host (a free numpy
+  ``view``), never on device.
+* The byte stream is stored as little-endian uint32 **words**; a byte
+  load is a word gather + shift, so XLA moves 32-bit lanes, not bytes.
+* Every reader takes a ``mask`` lane vector and advances cursors only
+  where the lane is active — masking is how nullable branches, union
+  arms, and array-block loops compose without divergence.
+* Reads never fault: gathers are clipped to the buffer; malformed input
+  surfaces as per-lane error bits checked on the host afterwards.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = [
+    "U32",
+    "get_byte",
+    "load_window",
+    "read_varint64",
+    "read_varint32",
+    "zigzag_decode_pair",
+    "read_f32",
+    "read_f64_pair",
+    "read_bool_byte",
+    "ERR_VARINT",
+    "ERR_NEG_LEN",
+    "ERR_OVERRUN",
+    "ERR_BAD_BRANCH",
+    "ERR_BAD_ENUM",
+    "ERR_TRAILING",
+    "ERR_BAD_BOOL",
+    "ERR_ITEM_OVERFLOW",
+    "ERR_NAMES",
+]
+
+U32 = jnp.uint32
+
+# per-lane error bits, OR-accumulated during the walk and checked on host
+ERR_VARINT = 1 << 0        # varint longer than 10 bytes
+ERR_NEG_LEN = 1 << 1       # negative string/bytes length
+ERR_OVERRUN = 1 << 2       # cursor ran past the record's end
+ERR_BAD_BRANCH = 1 << 3    # union branch index out of range
+ERR_BAD_ENUM = 1 << 4      # enum index out of range
+ERR_TRAILING = 1 << 5      # datum not fully consumed (trailing bytes)
+ERR_BAD_BOOL = 1 << 6      # boolean byte not 0/1
+ERR_ITEM_OVERFLOW = 1 << 7 # array/map items exceeded the slot cap (retry)
+
+ERR_NAMES = {
+    ERR_VARINT: "varint longer than 10 bytes",
+    ERR_NEG_LEN: "negative string/bytes length",
+    ERR_OVERRUN: "value runs past end of datum",
+    ERR_BAD_BRANCH: "union branch index out of range",
+    ERR_BAD_ENUM: "enum index out of range",
+    ERR_TRAILING: "trailing bytes after datum",
+    ERR_BAD_BOOL: "invalid boolean byte",
+    ERR_ITEM_OVERFLOW: "array/map item capacity overflow",
+}
+
+
+def get_byte(words: jnp.ndarray, idx: jnp.ndarray) -> jnp.ndarray:
+    """Byte ``idx`` of the little-endian u32-word buffer, as uint32 lanes.
+
+    Out-of-range indices clip to the last word (callers mask the result);
+    negative clip to 0.
+    """
+    w = jnp.take(words, lax.shift_right_logical(idx, 2), mode="clip")
+    shift = (jnp.bitwise_and(idx, 3) << 3).astype(U32)
+    return jnp.bitwise_and(lax.shift_right_logical(w, shift), U32(0xFF))
+
+
+def load_window(words, cursor, nwords: int):
+    """Gather ``nwords`` consecutive u32 words at ``cursor``'s word and
+    funnel-shift them into ``nwords - 1`` words whose byte 0 IS the byte at
+    ``cursor``. One gather per word; everything after is register ALU —
+    this keeps the XLA gather chain short, which dominates both compile
+    time and TPU issue rate (the VPU moves 32-bit lanes, never bytes).
+    """
+    wbase = lax.shift_right_logical(cursor, 2)
+    win = [jnp.take(words, wbase + k, mode="clip") for k in range(nwords)]
+    a = (jnp.bitwise_and(cursor, 3) << 3).astype(U32)  # bit offset 0/8/16/24
+    nz = a != U32(0)
+    inv = (U32(32) - a) & U32(31)
+    out = []
+    for k in range(nwords - 1):
+        hi = jnp.where(nz, win[k + 1] << inv, U32(0))
+        out.append(lax.shift_right_logical(win[k], a) | hi)
+    return out
+
+
+def _window_byte(aligned, k: int):
+    """Byte ``k`` (static) of the funnel-aligned window."""
+    return jnp.bitwise_and(
+        lax.shift_right_logical(aligned[k >> 2], U32((k & 3) * 8)), U32(0xFF)
+    )
+
+
+def _read_varint(words, cursor, mask, max_bytes: int):
+    aligned = load_window(words, cursor, (max_bytes + 3) // 4 + 1)
+    lo = jnp.zeros_like(cursor, dtype=U32)
+    hi = jnp.zeros_like(cursor, dtype=U32)
+    more = mask
+    nbytes = jnp.zeros_like(cursor)
+    for k in range(max_bytes):
+        b = _window_byte(aligned, k)
+        g = jnp.bitwise_and(b, U32(0x7F))
+        s = 7 * k
+        if s < 32:
+            lo = lo | jnp.where(more, g << s, U32(0))
+            if s + 7 > 32:  # the straddling group (k=4, bits 28..34)
+                hi = hi | jnp.where(
+                    more, lax.shift_right_logical(g, U32(32 - s)), U32(0)
+                )
+        else:
+            hi = hi | jnp.where(more, g << (s - 32), U32(0))
+        nbytes = nbytes + more.astype(cursor.dtype)
+        more = more & (b >= U32(0x80))
+    err = jnp.where(more, jnp.uint32(ERR_VARINT), jnp.uint32(0))
+    return lo, hi, cursor + nbytes, err
+
+
+def read_varint64(words, cursor, mask):
+    """Read one unsigned LEB128 varint (≤10 bytes) per active lane.
+
+    Returns ``(lo u32, hi u32, new_cursor i32, err u32)``; cursors advance
+    only where ``mask``. ≙ the byte loop of ``fast_decode.rs:855-869``,
+    unrolled to the wire format's static 10-byte maximum (4 word gathers).
+    """
+    return _read_varint(words, cursor, mask, 10)
+
+
+def read_varint32(words, cursor, mask):
+    """5-byte varint for quantities that must fit a record anyway — union
+    branches, enum indices, string lengths, array/map block counts. A
+    longer varint encodes a value that could not be in-bounds, so it
+    surfaces as ERR_VARINT (→ MalformedAvro) rather than paying the
+    10-byte gather chain on every hot read. 3 word gathers."""
+    return _read_varint(words, cursor, mask, 5)
+
+
+def zigzag_decode_pair(lo, hi):
+    """Zig-zag decode a u32 pair: ``(n >> 1) ^ -(n & 1)`` in 64-bit
+    two's-complement carried as two u32 words (≙ ``fast_decode.rs:867``)."""
+    sign = jnp.bitwise_and(lo, U32(1))
+    lo1 = lax.shift_right_logical(lo, U32(1)) | (hi << 31)
+    hi1 = lax.shift_right_logical(hi, U32(1))
+    m = jnp.zeros_like(lo) - sign  # 0x00000000 or 0xFFFFFFFF
+    return lo1 ^ m, hi1 ^ m
+
+
+def read_f32(words, cursor, mask):
+    """IEEE-754 float32, little-endian (≙ ``read_f32`` ``fast_decode.rs:872``):
+    one funnel-aligned word, bitcast."""
+    (v,) = load_window(words, cursor, 2)
+    return (
+        lax.bitcast_convert_type(v, jnp.float32),
+        cursor + jnp.where(mask, 4, 0),
+    )
+
+
+def read_f64_pair(words, cursor, mask):
+    """IEEE-754 float64 as a (lo, hi) u32 pair — recombined and bitcast on
+    the host (≙ ``read_f64`` ``fast_decode.rs:882``)."""
+    lo, hi = load_window(words, cursor, 3)
+    return lo, hi, cursor + jnp.where(mask, 8, 0)
+
+
+def read_bool_byte(words, cursor, mask):
+    """One boolean byte; bytes >1 set ERR_BAD_BOOL
+    (≙ ``read_bool`` ``fast_decode.rs:893-900``)."""
+    b = get_byte(words, cursor)
+    err = jnp.where(mask & (b > U32(1)), jnp.uint32(ERR_BAD_BOOL), jnp.uint32(0))
+    return b.astype(jnp.uint8), cursor + jnp.where(mask, 1, 0), err

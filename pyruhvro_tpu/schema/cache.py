@@ -32,7 +32,9 @@ class SchemaEntry:
         self.schema_str = schema_str
         self.ir = ir
         self._arrow: Optional[pa.Schema] = None
-        self._lock = threading.Lock()
+        # reentrant: a get_extra factory may itself touch arrow_schema or
+        # another extra (e.g. the device codec reads the Arrow schema)
+        self._lock = threading.RLock()
         self._extras: Dict[str, object] = {}
 
     @property

@@ -3,9 +3,17 @@
 Multi-chip configs are tested on CPU via device-count spoofing
 (SURVEY.md §4.7): real-TPU behavior is exercised by the driver's bench
 run, not by unit tests. Must run before the first `import jax` anywhere.
+
+Device-tunnel site hooks (e.g. axon) hijack JAX backend resolution for
+the whole process — even in CPU mode a wedged tunnel would hang the
+suite. They install at interpreter startup (PYTHONPATH site entries),
+before conftest runs, so scrubbing the path is not enough: the installed
+``_get_backend_uncached`` wrapper must be unwound and the platform
+config pinned back to cpu.
 """
 
 import os
+import sys
 
 os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
@@ -13,3 +21,28 @@ if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+# keep subprocesses (if any) clean too
+os.environ["PYTHONPATH"] = os.pathsep.join(
+    p
+    for p in os.environ.get("PYTHONPATH", "").split(os.pathsep)
+    if p and ".axon_site" not in p
+)
+sys.path[:] = [p for p in sys.path if ".axon_site" not in p]
+
+if any("axon" in name for name in list(sys.modules)):
+    # the tunnel hook is already installed: unwind it and re-pin cpu
+    import jax
+    from jax._src import xla_bridge as _xb
+
+    hook = _xb._get_backend_uncached
+    if getattr(hook, "__name__", "") == "_axon_get_backend_uncached":
+        for cell in hook.__closure__ or ():
+            try:
+                v = cell.cell_contents
+            except ValueError:
+                continue
+            if callable(v):
+                _xb._get_backend_uncached = v
+                break
+    jax.config.update("jax_platforms", "cpu")
