@@ -23,6 +23,7 @@ from .api import (
     serialize_record_batch_spawn,
 )
 from .gate import is_supported
+from .runtime import metrics
 from .schema import parse_schema, to_arrow_schema
 
 __version__ = "0.1.0"
@@ -36,5 +37,6 @@ __all__ = [
     "is_supported",
     "parse_schema",
     "to_arrow_schema",
+    "metrics",
     "__version__",
 ]

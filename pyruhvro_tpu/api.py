@@ -153,16 +153,17 @@ def deserialize_array_threaded(
     """Decode in ``num_chunks`` chunks → one RecordBatch per chunk
     (≙ ``deserialize_array_threaded``, ``src/lib.rs:73-89``).
 
-    On the device path, chunking shapes only the returned batch
-    boundaries — the whole input is decoded in one gridded launch
-    (the chunk axis maps to the device grid, not host threads)."""
+    On the device path the chunk axis maps to the device mesh, not host
+    threads: with multiple devices attached, chunks are decoded by
+    ``shard_map`` over the mesh's ``"chunks"`` axis in one launch
+    (``parallel/sharded.py``); on a single chip the whole input is
+    decoded in one fused launch and sliced per chunk."""
     _check_backend(backend)
     entry = get_or_parse_schema(schema)
     bounds = chunk_bounds(len(data), num_chunks)
     codec = _device_codec(entry, backend)
     if codec is not None:
-        batch = codec.decode(data)
-        return [batch.slice(a, b - a) for a, b in bounds]
+        return codec.decode_threaded(data, num_chunks)
     ir, arrow, reader = entry.ir, entry.arrow_schema, _host_reader(entry)
     return map_chunks(
         lambda ab: decode_to_record_batch(data[ab[0]:ab[1]], ir, arrow, reader),

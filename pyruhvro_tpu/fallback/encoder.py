@@ -348,7 +348,8 @@ def _non_null(writer, what: str):
 def compile_encoder_plan(t: Record) -> List[tuple]:
     """Schema-only work of :func:`encode_record_batch`, computed once per
     schema and reusable across chunks/calls (cache it via
-    ``SchemaEntry.get_extra``): per field ``(name, expected_type, writer)``."""
+    ``SchemaEntry.get_extra``): per field
+    ``(name, expected_arrow_type, avro_type, writer)``."""
     if not isinstance(t, Record):
         raise ValueError("top-level Avro schema must be a record")
     return [

@@ -4,7 +4,7 @@ Submodules (imported lazily so that merely importing :mod:`pyruhvro_tpu`
 never pays the JAX startup cost — the reference's host-only import path
 is similarly cheap):
 
-* :mod:`.varint`    — vectorized zig-zag varint read/write primitives
+* :mod:`.varint`    — vectorized zig-zag varint read primitives
 * :mod:`.fieldprog` — Avro schema IR → static field program (output specs)
 * :mod:`.decode`    — the jitted record-walk decode kernel
 * :mod:`.arrow_build` — device outputs → ``pyarrow`` arrays

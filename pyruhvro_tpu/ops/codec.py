@@ -22,7 +22,7 @@ import pyarrow as pa
 
 from ..schema.cache import SchemaEntry
 from . import UnsupportedOnDevice
-from .decode import DeviceDecoder
+from .decode import BatchTooLarge, DeviceCapacityExceeded, DeviceDecoder
 
 __all__ = ["DeviceCodec", "get_device_codec"]
 
@@ -77,6 +77,7 @@ class DeviceCodec:
         self.arrow_schema = entry.arrow_schema
         self.decoder = DeviceDecoder(entry.ir)
         self._encoder = None
+        self._sharded = None  # lazily: ShardedDecoder | False (single-chip)
         # probe the backend now: a missing/broken device must fail at
         # construction (where api.py distinguishes it from unsupported
         # schemas), not on the first decode call. The probe is
@@ -84,34 +85,144 @@ class DeviceCodec:
         # host path, not hang every backend='auto' caller forever.
         _probe_backend()
 
+    def _host_decode(self, data: Sequence[bytes]) -> pa.RecordBatch:
+        """Host-path decode reusing the per-schema memoized wire reader
+        (same cache key as ``api._host_reader``)."""
+        from ..fallback.decoder import compile_reader, decode_to_record_batch
+
+        reader = self.entry.get_extra(
+            "host_reader", lambda: compile_reader(self.ir)
+        )
+        return decode_to_record_batch(
+            data, self.ir, self.arrow_schema, reader
+        )
+
     def decode(self, data: Sequence[bytes]) -> pa.RecordBatch:
         if len(data) == 0:
             # empty launch has no shapes to compile; build directly
-            from ..fallback.decoder import decode_to_record_batch
-
-            return decode_to_record_batch([], self.ir, self.arrow_schema)
-        from .decode import DeviceCapacityExceeded
-
+            return self._host_decode([])
         try:
             host, n, meta = self.decoder.decode_to_columns(data)
+        except BatchTooLarge:
+            # one launch is bounded to 1 GiB of datum bytes (int32
+            # cursors): recursively halve the batch — each half still
+            # decodes on device — and concatenate the results, so the
+            # public API never surfaces the launch-size limit
+            if len(data) < 2:
+                # one giant datum can't be split: serve it from the host
+                # path like any other beyond-device-capacity batch
+                return self._host_decode(data)
+            mid = len(data) // 2
+            left = self.decode(data[:mid])
+            right = self.decode(data[mid:])
+            return _concat_batches([left, right])
         except DeviceCapacityExceeded:
             # a batch whose per-record item counts exceed device capacity
             # is still valid Avro: serve it from the general path (the
             # same degradation the reference applies to unsupported
             # schemas, deserialize.rs:26-29 — here per batch)
-            from ..fallback.decoder import decode_to_record_batch
-
-            return decode_to_record_batch(data, self.ir, self.arrow_schema)
+            return self._host_decode(data)
         from .arrow_build import build_record_batch
 
         return build_record_batch(self.ir, self.arrow_schema, host, n, meta)
+
+    def _sharded_decoder(self):
+        """The mesh-sharded decoder when >1 device is attached, else None
+        (single chip: the fused single-launch path is already optimal)."""
+        if self._sharded is None:
+            import jax
+
+            devs = jax.devices()
+            if len(devs) > 1:
+                from ..parallel import ShardedDecoder
+
+                self._sharded = ShardedDecoder(base=self.decoder,
+                                               devices=devs)
+            else:
+                self._sharded = False
+        return self._sharded or None
+
+    def decode_threaded(self, data: Sequence[bytes],
+                        num_chunks: int) -> List[pa.RecordBatch]:
+        """Chunked decode → one RecordBatch per chunk (≙ the threaded
+        entry, ``deserialize.rs:76-121``).
+
+        With a multi-device mesh and ``num_chunks`` == mesh size, chunks
+        map 1:1 onto devices in one sharded launch (the TPU-native
+        analogue of one thread per chunk). Any other chunk count decodes
+        once — sharded when possible — and slices the result, preserving
+        the exact chunk boundaries of the reference."""
+        from ..runtime.chunking import chunk_bounds
+
+        bounds = chunk_bounds(len(data), num_chunks)
+        sd = self._sharded_decoder() if len(data) else None
+        if sd is not None:
+            try:
+                batches = sd.decode(data, self.ir, self.arrow_schema)
+            except BatchTooLarge:
+                batches = None  # per-shard byte budget blown: split below
+            except DeviceCapacityExceeded:
+                from ..runtime.pool import map_chunks
+
+                return map_chunks(
+                    lambda ab: self._host_decode(data[ab[0]:ab[1]]), bounds
+                )
+            if batches is not None:
+                if len(batches) == len(bounds):
+                    # mesh shards used reference slicing too → exact match
+                    return batches
+                whole = _concat_batches(batches)
+                return [whole.slice(a, b - a) for a, b in bounds]
+        batch = self.decode(data)
+        return [batch.slice(a, b - a) for a, b in bounds]
 
     def encode(self, batch: pa.RecordBatch) -> pa.Array:
         if self._encoder is None:
             from .encode import DeviceEncoder
 
-            self._encoder = DeviceEncoder(self.ir, self.arrow_schema)
-        return self._encoder.encode(batch)
+            try:
+                self._encoder = DeviceEncoder(self.ir, self.arrow_schema)
+            except UnsupportedOnDevice:
+                # encode subset narrower than decode's for this schema:
+                # serve serialize from the host path (silent fallback,
+                # ≙ serialize.rs:53-56)
+                self._encoder = False
+        if self._encoder is False:
+            return self._host_encode(batch)
+        try:
+            return self._encoder.encode(batch)
+        except BatchTooLarge:
+            # output would blow the 2^30-byte launch budget: halve the
+            # batch (still on device), or for one giant row go host
+            if batch.num_rows < 2:
+                return self._host_encode(batch)
+            mid = batch.num_rows // 2
+            return pa.concat_arrays([
+                self.encode(batch.slice(0, mid)),
+                self.encode(batch.slice(mid)),
+            ])
+
+    def _host_encode(self, batch: pa.RecordBatch) -> pa.Array:
+        from ..fallback.encoder import (
+            compile_encoder_plan,
+            encode_record_batch,
+        )
+
+        plan = self.entry.get_extra(
+            "host_encode_plan", lambda: compile_encoder_plan(self.ir)
+        )
+        return pa.array(
+            encode_record_batch(batch, self.ir, plan), pa.binary()
+        )
+
+
+def _concat_batches(batches: List[pa.RecordBatch]) -> pa.RecordBatch:
+    """Concatenate RecordBatches into one (pyarrow-version tolerant)."""
+    if hasattr(pa, "concat_batches"):
+        return pa.concat_batches(batches)
+    table = pa.Table.from_batches(batches).combine_chunks()
+    out = table.to_batches()
+    return out[0] if out else batches[0]
 
 
 def get_device_codec(entry: SchemaEntry) -> DeviceCodec:

@@ -30,12 +30,85 @@ from typing import Dict, List, Sequence, Tuple
 
 import numpy as np
 
+import time
+
 from ..fallback.io import MalformedAvro
+from ..runtime import metrics
 from ..runtime.pack import bucket_len, concat_records
 from .fieldprog import ROWS, Program, lower
 from .varint import ERR_ITEM_OVERFLOW, ERR_NAMES
 
-__all__ = ["DeviceDecoder", "DeviceCapacityExceeded"]
+__all__ = [
+    "DeviceDecoder",
+    "DeviceCapacityExceeded",
+    "BatchTooLarge",
+    "split_blob",
+    "pad_views",
+]
+
+
+def split_blob(blob: np.ndarray, layout) -> Dict[str, np.ndarray]:
+    """Split one transferred uint8 blob back into named host views by the
+    pipeline's static ``[(key, dtype, length), ...]`` layout."""
+    host: Dict[str, np.ndarray] = {}
+    pos = 0
+    for key, dt, ln in layout:
+        nbytes = np.dtype(dt).itemsize * ln
+        host[key] = blob[pos : pos + nbytes].view(dt)
+        pos += nbytes
+    assert pos == blob.nbytes, "pipeline layout mismatch"
+    return host
+
+
+def _region_counts(ir, batch, path: str):
+    """Per-row item counts of the repeated field at ``path`` in an Arrow
+    batch (host-side, for cap seeding). Path components are record field
+    names or union-arm indices; nullable pairs are transparent (Arrow
+    folds them into field nullability)."""
+    from ..schema.model import Array as _Arr, Map as _Map, Record, Union
+
+    t = ir
+    arr = None
+    for comp in path.split("/"):
+        while isinstance(t, Union) and t.is_nullable_pair:
+            t = t.non_null_variant
+        if isinstance(t, Record):
+            names = [f.name for f in t.fields]
+            i = names.index(comp)
+            arr = batch.column(comp) if arr is None else arr.field(i)
+            t = t.fields[i].type
+        elif isinstance(t, Union):
+            k = int(comp)
+            arr = arr.field(k)
+            t = t.variants[k]
+        else:
+            return None
+    while isinstance(t, Union) and t.is_nullable_pair:
+        t = t.non_null_variant
+    if arr is None or not isinstance(t, (_Arr, _Map)):
+        return None
+    counts = np.diff(np.asarray(arr.offsets))
+    if arr.null_count:
+        counts = np.where(
+            arr.is_valid().to_numpy(zero_copy_only=False), counts, 0
+        )
+    return counts
+
+
+def pad_views(flat: np.ndarray, offsets: np.ndarray, n: int, R: int, B: int):
+    """Shape one packed record run into launch inputs: ``flat`` padded to
+    ``B`` bytes viewed as LE u32 ``words``, plus ``starts``/``lengths``
+    lane vectors padded to ``R`` (inactive lanes: start=B, length=0).
+    Returns ``(words, starts, lengths, flat_padded)``."""
+    total = int(offsets[-1])
+    if B != total:
+        flat = np.concatenate([flat, np.zeros(B - total, np.uint8)])
+    words = np.ascontiguousarray(flat).view(np.uint32)
+    starts = np.full(R, B, np.int32)
+    starts[:n] = offsets[:-1]
+    lengths = np.zeros(R, np.int32)
+    lengths[:n] = np.diff(offsets).astype(np.int32)
+    return words, starts, lengths, flat
 
 _DEFAULT_ITEM_CAP = 8
 _DEFAULT_TOT_CAP = 8
@@ -48,6 +121,11 @@ _cache_enabled = False
 class DeviceCapacityExceeded(Exception):
     """Batch needs more per-record item slots than the device path
     supports; the caller decodes it on the host instead."""
+
+
+class BatchTooLarge(Exception):
+    """Batch exceeds the single-launch byte budget (int32 cursors);
+    the codec splits it and decodes the pieces (still on device)."""
 
 
 def _enable_persistent_cache(jax) -> None:
@@ -131,17 +209,19 @@ class DeviceDecoder:
 
     # -- the fused pipeline ------------------------------------------------
 
-    def _pipeline_fn(self, R: int, B: int, item_caps: Tuple[int, ...],
-                     tot_caps: Tuple[int, ...]):
-        """Compiled fused walk+finalize. Returns ``(fn, layout)`` where
-        ``fn(words, starts, lengths, n)`` yields ONE uint8 blob and
-        ``layout`` is ``[(key, dtype, length), ...]`` for the host split.
-        The blob also carries the reductions (error flag, per-region item
-        max/sum) so the steady state costs a single device round trip."""
-        key = (R, B, item_caps, tot_caps)
-        hit = self._pipe_cache.get(key)
-        if hit is not None:
-            return hit
+    def build_pipeline(self, R: int, B: int, item_caps: Tuple[int, ...],
+                       tot_caps: Tuple[int, ...]):
+        """Build the (unjitted) fused walk+finalize. Returns
+        ``(fn, layout)`` where ``fn(words, starts, lengths, n)`` yields
+        ONE uint8 blob and ``layout`` is ``[(key, dtype, length), ...]``
+        for the host split. The blob also carries the reductions (error
+        flag, per-region item max/sum) so the steady state costs a single
+        device round trip.
+
+        The raw callable is what :mod:`..parallel` ``shard_map``s over a
+        device mesh (each mesh shard runs it on its chunk) and what
+        ``__graft_entry__.entry()`` hands the driver for compile checks;
+        single-device callers use :meth:`_pipeline_fn` (jit + cache)."""
         jax = self._jax
         jnp = jax.numpy
         lax = jax.lax
@@ -223,8 +303,18 @@ class DeviceDecoder:
                 sizes[spec.key] = (np.dtype(spec.dtype), R)
         sizes["#red:err"] = (np.uint8, 1)
         layout = [(k,) + sizes[k] for k in sorted(sizes)]
+        return pipeline, layout
 
-        pair = (jax.jit(pipeline), layout)
+    def _pipeline_fn(self, R: int, B: int, item_caps: Tuple[int, ...],
+                     tot_caps: Tuple[int, ...]):
+        """Jitted-and-cached :meth:`build_pipeline` (one compile per
+        (R, B, caps) bucket for the process, ≙ the schema→kernel cache)."""
+        key = (R, B, item_caps, tot_caps)
+        hit = self._pipe_cache.get(key)
+        if hit is not None:
+            return hit
+        pipeline, layout = self.build_pipeline(R, B, item_caps, tot_caps)
+        pair = (self._jax.jit(pipeline), layout)
         with self._lock:
             self._pipe_cache[key] = pair
         return pair
@@ -244,41 +334,59 @@ class DeviceDecoder:
                 self._err_cache[key] = fn
         return fn
 
-    # -- orchestration -----------------------------------------------------
+    # -- capacity bookkeeping (shared with parallel.ShardedDecoder) --------
 
-    def decode_to_columns(self, data: Sequence[bytes]):
-        """Run the pipeline; returns ``(host_columns, n, meta)`` where meta
-        carries per-region item totals and the raw datum bytes for the
-        host-side assembly."""
-        jax = self._jax
-        n = len(data)
-        flat, offsets = concat_records(data)
-        total = int(offsets[-1])
-        if total > (1 << 30):
-            # int32 cursors: callers split giant batches (runtime/chunking)
-            raise ValueError(
-                "batch exceeds 1 GiB of datum bytes; split it into chunks"
-            )
-        B = bucket_len(max(total, 4), minimum=16)
-        R = bucket_len(max(n, 1), minimum=8)
-        if B != total:
-            flat = np.concatenate([flat, np.zeros(B - total, np.uint8)])
-        words = np.ascontiguousarray(flat).view(np.uint32)
-        starts = np.full(R, B, np.int32)
-        starts[:n] = offsets[:-1]
-        lengths = np.zeros(R, np.int32)
-        lengths[:n] = np.diff(offsets)
-
-        words_d = jax.device_put(words)
-        starts_d = jax.device_put(starts)
-        lengths_d = jax.device_put(lengths)
-        n_d = np.int32(n)
-
+    def seed_caps_from_sample(self, data: Sequence[bytes], R: int) -> None:
+        """Estimate item caps for a fresh ``R`` bucket from a small
+        host-decoded sample, so the first device launch compiles ONCE
+        instead of climbing the retry ladder (each rung is a recompile —
+        and with remote compile, a tunnel round trip). Estimates only:
+        the ladder still catches under-estimates; sampling errors
+        (malformed head records) are ignored and left to the device
+        pass, which reports exact per-record errors."""
         prog = self.prog
-        host = None
-        # zero-byte items (null / empty-record) reveal their true count only
-        # ~cap-at-a-time, so cap growth can take ~log2(_MAX_ITEM_CAP) rounds
-        for _attempt in range(24):
+        if len(prog.regions) <= 1:
+            return
+        with self._lock:
+            need = [
+                rid
+                for rid in range(1, len(prog.regions))
+                if (R, rid) not in self._tot_cap_mem
+            ]
+        if not need:
+            return
+        k = min(len(data), 128)
+        try:
+            from ..fallback.decoder import decode_to_record_batch
+            from ..schema.arrow_map import to_arrow_schema
+
+            sample = decode_to_record_batch(
+                data[:k], prog.ir, to_arrow_schema(prog.ir)
+            )
+        except Exception:
+            return
+        for rid in need:
+            counts = _region_counts(prog.ir, sample, prog.regions[rid])
+            if counts is None or counts.size == 0:
+                continue
+            mx = int(counts.max(initial=0))
+            avg = float(counts.mean())
+            with self._lock:
+                self._item_caps[rid] = max(
+                    self._item_caps[rid],
+                    bucket_len(mx + (mx >> 1) + 1,
+                               minimum=_DEFAULT_ITEM_CAP),
+                )
+                est = int(R * avg * 1.25) + 16
+                self._tot_cap_mem[(R, rid)] = max(
+                    self._tot_cap_mem.get((R, rid), 0),
+                    bucket_len(est, minimum=_DEFAULT_TOT_CAP),
+                )
+
+    def caps_snapshot(self, R: int):
+        """Atomic snapshot of ``(item_caps, tot_caps)`` for an R bucket."""
+        prog = self.prog
+        with self._lock:
             item_caps = tuple(self._item_caps)
             tot_caps = tuple(
                 [0]
@@ -290,39 +398,101 @@ class DeviceDecoder:
                     for rid in range(1, len(prog.regions))
                 ]
             )
-            fn, layout = self._pipeline_fn(R, B, item_caps, tot_caps)
-            blob = np.asarray(
-                jax.device_get(fn(words_d, starts_d, lengths_d, n_d))
-            )
-            host = {}
-            pos = 0
-            for key, dt, ln in layout:
-                nbytes = np.dtype(dt).itemsize * ln
-                host[key] = blob[pos : pos + nbytes].view(dt)
-                pos += nbytes
-            assert pos == blob.nbytes, "pipeline layout mismatch"
-            retry = False
-            for rid, path in enumerate(prog.regions):
-                if rid == ROWS:
-                    continue
-                maxc = int(host["#red:max:" + path][0])
-                sumc = int(host["#red:sum:" + path][0])
+        return item_caps, tot_caps
+
+    def grow_caps(self, R, item_caps, tot_caps, red_max, red_sum) -> bool:
+        """Grow remembered caps from observed per-region reductions
+        (max items/record, total items). Returns True when any cap grew
+        (→ the caller retries the launch with the bigger bucket).
+
+        ``red_max`` / ``red_sum`` are ``{rid: int}`` — for sharded
+        launches, already max-reduced across shards."""
+        retry = False
+        with self._lock:  # cap growth is monotonic; max() keeps it so
+            for rid in red_max:
+                maxc, sumc = red_max[rid], red_sum[rid]
                 if maxc > item_caps[rid]:
                     if maxc > _MAX_ITEM_CAP:
                         raise DeviceCapacityExceeded(
-                            f"{path!r} needs {maxc} item slots per record "
-                            f"(device limit {_MAX_ITEM_CAP})"
+                            f"{self.prog.regions[rid]!r} needs {maxc} item "
+                            f"slots per record (device limit {_MAX_ITEM_CAP})"
                         )
-                    self._item_caps[rid] = bucket_len(
-                        maxc, minimum=_DEFAULT_ITEM_CAP
+                    self._item_caps[rid] = max(
+                        self._item_caps[rid],
+                        bucket_len(maxc, minimum=_DEFAULT_ITEM_CAP),
                     )
                     retry = True
                 if sumc > tot_caps[rid]:
-                    self._tot_cap_mem[(R, rid)] = bucket_len(
-                        max(sumc, 1), minimum=_DEFAULT_TOT_CAP
+                    self._tot_cap_mem[(R, rid)] = max(
+                        self._tot_cap_mem.get((R, rid), 0),
+                        bucket_len(max(sumc, 1), minimum=_DEFAULT_TOT_CAP),
                     )
                     retry = True
-            if not retry:
+        return retry
+
+    # -- orchestration -----------------------------------------------------
+
+    def decode_to_columns(self, data: Sequence[bytes]):
+        """Run the pipeline; returns ``(host_columns, n, meta)`` where meta
+        carries per-region item totals and the raw datum bytes for the
+        host-side assembly."""
+        jax = self._jax
+        n = len(data)
+        with metrics.timer("decode.pack_s"):
+            flat, offsets = concat_records(data)
+        total = int(offsets[-1])
+        if total > (1 << 30):
+            # int32 cursors bound one launch to 1 GiB of datum bytes; the
+            # codec catches this and auto-splits the batch (codec.py)
+            raise BatchTooLarge(n, total)
+        B = bucket_len(max(total, 4), minimum=16)
+        R = bucket_len(max(n, 1), minimum=8)
+        self.seed_caps_from_sample(data, R)
+        words, starts, lengths, flat = pad_views(flat, offsets, n, R, B)
+
+        with metrics.timer("decode.h2d_s"):
+            words_d = jax.device_put(words)
+            starts_d = jax.device_put(starts)
+            lengths_d = jax.device_put(lengths)
+        metrics.inc(
+            "decode.h2d_bytes",
+            words.nbytes + starts.nbytes + lengths.nbytes,
+        )
+        n_d = np.int32(n)
+
+        prog = self.prog
+        host = None
+        # zero-byte items (null / empty-record) reveal their true count only
+        # ~cap-at-a-time, so cap growth can take ~log2(_MAX_ITEM_CAP) rounds
+        for _attempt in range(24):
+            item_caps, tot_caps = self.caps_snapshot(R)
+            fresh = (R, B, item_caps, tot_caps) not in self._pipe_cache
+            fn, layout = self._pipeline_fn(R, B, item_caps, tot_caps)
+            t0 = time.perf_counter()
+            res = fn(words_d, starts_d, lengths_d, n_d)
+            res.block_until_ready()
+            dt = time.perf_counter() - t0
+            if fresh:  # first call pays trace+XLA-compile; track apart
+                metrics.inc("decode.compiles")
+                metrics.inc("decode.compile_launch_s", dt)
+            else:
+                metrics.inc("decode.launches")
+                metrics.inc("decode.launch_s", dt)
+            with metrics.timer("decode.d2h_s"):
+                blob = np.asarray(jax.device_get(res))
+            metrics.inc("decode.d2h_bytes", blob.nbytes)
+            host = split_blob(blob, layout)
+            red_max = {
+                rid: int(host["#red:max:" + path][0])
+                for rid, path in enumerate(prog.regions)
+                if rid != ROWS
+            }
+            red_sum = {
+                rid: int(host["#red:sum:" + path][0])
+                for rid, path in enumerate(prog.regions)
+                if rid != ROWS
+            }
+            if not self.grow_caps(R, item_caps, tot_caps, red_max, red_sum):
                 break
         else:
             raise MalformedAvro("array/map item capacity did not converge")

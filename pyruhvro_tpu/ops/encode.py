@@ -1,0 +1,921 @@
+"""Jitted encode pipeline: Arrow columns → Avro wire bytes, one launch.
+
+TPU-native counterpart of the reference's fast encoder
+(``ruhvro/src/fast_encode.rs:27-599``), designed from the format rather
+than translated: the reference writes each row sequentially into a
+reused buffer (``fast_encode.rs:44-52``); on TPU the key observation is
+that **encoding, unlike decoding, needs no sequential walk at all** —
+every output byte's position is computable ahead of time:
+
+1. a vectorized **size pass** computes the exact wire size of every
+   element of every region (rows; flat array/map item axes) — varint
+   widths from value magnitudes, string lengths from Arrow offsets,
+   per-row item sums via one segment-sum,
+2. **prefix sums** turn sizes into exact byte positions: row offsets
+   over the batch, item offsets within each row's block,
+3. a fully parallel **scatter pass** writes every field of every row at
+   its precomputed position — no loop-carried cursor anywhere; string
+   payload bytes are copied by one bulk gather/scatter per column.
+
+One launch returns one blob (output bytes + per-row sizes); the host
+wraps it zero-copy into a ``pyarrow`` BinaryArray whose value buffer IS
+the device output. Wire form matches the host oracle byte-for-byte:
+minimal zig-zag varints, arrays/maps in single-block form
+``[count, items..., 0]`` with bare ``0`` for empty
+(≙ ``fast_encode.rs:518-554``), nullable branch indices per the schema's
+union order, enum symbol indices (``fast_encode.rs:356-362``).
+
+Output capacity is static per launch: the host computes a cheap upper
+bound (max varint widths + exact string byte totals), bucketed so the
+jit cache stays small. No retry ladder is ever needed — encode sizes,
+unlike decode item counts, are boundable before launch.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Callable, Dict, List, Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+import pyarrow as pa
+from jax import lax
+
+from . import UnsupportedOnDevice
+from .fieldprog import ROWS, _BIG
+from ..gate import is_supported
+from ..runtime.pack import bucket_len
+from ..schema.model import (
+    Array,
+    AvroType,
+    Enum,
+    Map,
+    Primitive,
+    Record,
+    Union,
+)
+
+__all__ = ["DeviceEncoder", "lower_encoder"]
+
+I32 = jnp.int32
+U32 = jnp.uint32
+
+
+# ---------------------------------------------------------------------------
+# traced helpers
+# ---------------------------------------------------------------------------
+
+def _zigzag32(v):
+    """Zig-zag of an int32 lane vector as a (lo, hi=0) u32 pair."""
+    x = v.astype(I32)
+    z = jnp.bitwise_xor(x << 1, x >> 31)  # arithmetic >> on int32
+    return lax.bitcast_convert_type(z, U32), jnp.zeros_like(v, dtype=U32)
+
+
+def _zigzag64(lo, hi):
+    """Zig-zag of an int64 carried as (lo, hi) u32 words."""
+    slo = lo << 1
+    shi = (hi << 1) | lax.shift_right_logical(lo, U32(31))
+    m = jnp.zeros_like(hi) - lax.shift_right_logical(hi, U32(31))  # 0/~0
+    return slo ^ m, shi ^ m
+
+
+def _varint_size(zlo, zhi):
+    """Wire bytes of an unsigned LEB128 varint given as a u32 pair."""
+    size = jnp.ones(zlo.shape, I32)
+    for k in range(1, 10):
+        bits = 7 * k
+        if bits < 32:
+            ge = (zhi != U32(0)) | (zlo >= U32(1 << bits))
+        else:
+            ge = zhi >= U32(1 << (bits - 32))
+        size = size + ge.astype(I32)
+    return size
+
+
+def _put_byte(out, idx, byte, mask):
+    safe = jnp.where(mask, idx, I32(_BIG))
+    return out.at[safe].set(byte.astype(jnp.uint8), mode="drop")
+
+
+def _put_varint(out, cursor, zlo, zhi, nbytes, mask):
+    """Scatter one varint per active lane at its cursor."""
+    for k in range(10):
+        bits = 7 * k
+        if bits < 32:
+            g = lax.shift_right_logical(zlo, U32(bits))
+            if bits + 7 > 32:
+                g = g | (zhi << U32(32 - bits))
+        else:
+            g = lax.shift_right_logical(zhi, U32(bits - 32))
+        g = jnp.bitwise_and(g, U32(0x7F))
+        byte = jnp.where(k < nbytes - 1, g | U32(0x80), g)
+        out = _put_byte(out, cursor + k, byte, mask & (k < nbytes))
+    return out
+
+
+def _row_of(offsets, n_entries: int, cap: int):
+    """entry index owning each position j < cap, given entry start
+    ``offsets`` (same scatter-max + cummax trick as the decoder)."""
+    m = jnp.zeros(cap, I32)
+    m = m.at[offsets[:n_entries]].max(
+        jnp.arange(n_entries, dtype=I32), mode="drop"
+    )
+    return lax.cummax(m)
+
+
+# ---------------------------------------------------------------------------
+# lowering: schema IR → size/write emitter tree
+# ---------------------------------------------------------------------------
+
+@dataclass
+class _StrCol:
+    path: str
+    region: int
+
+
+@dataclass
+class EncProgram:
+    ir: Record
+    regions: List[str]           # rid → path of the repeated field ("" = rows)
+    string_cols: List[_StrCol]
+    size: Callable               # size(cx) -> per-row i32 [R]
+    write: Callable              # write(cx, cursor, mask) -> None
+
+
+class _Cx:
+    """Traced state threaded through the emitters."""
+
+    __slots__ = ("dv", "out", "sizes", "str_dst", "item")
+
+    def __init__(self, dv, out):
+        self.dv = dv          # device input dict
+        self.out = out        # u8 output buffer (functionally updated)
+        self.sizes = {}       # path -> memoized size vector
+        self.str_dst = {}     # path -> (dst_start vec, write mask)
+        self.item = {}        # rid -> dict(row, within_base, active, total)
+
+
+class _EncLowering:
+    def __init__(self) -> None:
+        self.regions: List[str] = [""]
+        self.string_cols: List[_StrCol] = []
+
+    def lower_type(self, t: AvroType, path: str, region: int):
+        """Return ``(size, write)`` emitters for one value of ``t``.
+
+        ``size(cx) -> i32 vec`` over the region axis (garbage at masked
+        lanes — parents mask before aggregating). ``write(cx, cursor,
+        mask)`` scatters the value bytes at per-lane cursors."""
+        if isinstance(t, Primitive):
+            return self.lower_primitive(t, path, region)
+        if isinstance(t, Enum):
+            return self.lower_varint_leaf(path + "#v", path, wide=False)
+        if isinstance(t, Record):
+            return self.lower_record(t, path, region)
+        if isinstance(t, Union):
+            if t.is_nullable_pair:
+                return self.lower_nullable(t, path, region)
+            return self.lower_union(t, path, region)
+        if isinstance(t, (Array, Map)):
+            if region != ROWS:
+                raise UnsupportedOnDevice(
+                    f"nested repetition at {path!r} (array/map inside "
+                    f"array/map items) is outside the device subset"
+                )
+            return self.lower_repeated(t, path)
+        raise UnsupportedOnDevice(f"type {type(t).__name__} at {path!r}")
+
+    # -- leaves -----------------------------------------------------------
+
+    def lower_varint_leaf(self, key: str, path: str, wide: bool):
+        """int / long / enum-index: one zig-zag varint."""
+
+        def pair(cx):
+            if wide:
+                return _zigzag64(cx.dv[key + ":lo"], cx.dv[key + ":hi"])
+            return _zigzag32(cx.dv[key])
+
+        def size(cx):
+            s = cx.sizes.get(path)
+            if s is None:
+                s = cx.sizes[path] = _varint_size(*pair(cx))
+            return s
+
+        def write(cx, cursor, mask):
+            zlo, zhi = pair(cx)
+            cx.out = _put_varint(cx.out, cursor, zlo, zhi, size(cx), mask)
+
+        return size, write
+
+    def lower_primitive(self, t: Primitive, path: str, region: int):
+        name = t.name
+        if name == "null":
+            zero = lambda cx: jnp.zeros_like(  # noqa: E731
+                cx.dv["#active:%d" % region], dtype=I32
+            )
+            return zero, (lambda cx, cursor, mask: None)
+
+        if name in ("int", "long"):
+            return self.lower_varint_leaf(path + "#v", path, wide=name == "long")
+
+        if name == "float":
+
+            def size_f32(cx):
+                return jnp.full(cx.dv[path + "#v"].shape, 4, I32)
+
+            def write_f32(cx, cursor, mask):
+                w = lax.bitcast_convert_type(cx.dv[path + "#v"], U32)
+                for k in range(4):
+                    b = jnp.bitwise_and(
+                        lax.shift_right_logical(w, U32(8 * k)), U32(0xFF)
+                    )
+                    cx.out = _put_byte(cx.out, cursor + k, b, mask)
+
+            return size_f32, write_f32
+
+        if name == "double":
+
+            def size_f64(cx):
+                return jnp.full(cx.dv[path + "#v:lo"].shape, 8, I32)
+
+            def write_f64(cx, cursor, mask):
+                for half, word in enumerate((":lo", ":hi")):
+                    w = cx.dv[path + "#v" + word]
+                    for k in range(4):
+                        b = jnp.bitwise_and(
+                            lax.shift_right_logical(w, U32(8 * k)), U32(0xFF)
+                        )
+                        cx.out = _put_byte(
+                            cx.out, cursor + 4 * half + k, b, mask
+                        )
+
+            return size_f64, write_f64
+
+        if name == "boolean":
+
+            def size_b(cx):
+                return jnp.ones(cx.dv[path + "#v"].shape, I32)
+
+            def write_b(cx, cursor, mask):
+                cx.out = _put_byte(cx.out, cursor, cx.dv[path + "#v"], mask)
+
+            return size_b, write_b
+
+        if name == "string":
+            self.string_cols.append(_StrCol(path, region))
+
+            def size_s(cx):
+                s = cx.sizes.get(path)
+                if s is None:
+                    lens = cx.dv[path + "#len"]
+                    zlo, zhi = _zigzag32(lens)
+                    s = cx.sizes[path] = _varint_size(zlo, zhi) + lens
+                return s
+
+            def write_s(cx, cursor, mask):
+                lens = cx.dv[path + "#len"]
+                zlo, zhi = _zigzag32(lens)
+                ns = _varint_size(zlo, zhi)
+                cx.out = _put_varint(cx.out, cursor, zlo, zhi, ns, mask)
+                # payload bytes go in one bulk scatter after the walk
+                cx.str_dst[path] = (cursor + ns, mask)
+
+            return size_s, write_s
+
+        raise UnsupportedOnDevice(f"primitive {name!r} at {path!r}")
+
+    # -- composites -------------------------------------------------------
+
+    def lower_record(self, t: Record, path: str, region: int):
+        prefix = path + "/" if path else ""
+        fields = [
+            self.lower_type(f.type, prefix + f.name, region) for f in t.fields
+        ]
+
+        def size(cx):
+            s = cx.sizes.get(path + "#rec")
+            if s is None:
+                s = jnp.zeros(cx.dv["#active:%d" % region].shape, I32)
+                for fsize, _ in fields:
+                    s = s + fsize(cx)
+                cx.sizes[path + "#rec"] = s
+            return s
+
+        def write(cx, cursor, mask):
+            for fsize, fwrite in fields:
+                fwrite(cx, cursor, mask)
+                cursor = cursor + jnp.where(mask, fsize(cx), 0)
+
+        return size, write
+
+    def _branch_varint(self, branch):
+        """Branch indices are tiny non-negative ints."""
+        zlo, zhi = _zigzag32(branch)
+        return zlo, zhi, _varint_size(zlo, zhi)
+
+    def lower_nullable(self, t: Union, path: str, region: int):
+        """``["null", T]`` → branch varint + masked inner
+        (≙ ``build_nullable_encoder``, ``fast_encode.rs:285``)."""
+        null_idx = t.null_index
+        val_idx = 1 - null_idx
+        inner_size, inner_write = self.lower_type(
+            t.non_null_variant, path, region
+        )
+
+        def branch(cx):
+            valid = cx.dv[path + "#valid"].astype(bool)
+            return valid, jnp.where(valid, I32(val_idx), I32(null_idx))
+
+        def size(cx):
+            s = cx.sizes.get(path + "#nul")
+            if s is None:
+                valid, b = branch(cx)
+                _, _, ns = self._branch_varint(b)
+                s = ns + jnp.where(valid, inner_size(cx), 0)
+                cx.sizes[path + "#nul"] = s
+            return s
+
+        def write(cx, cursor, mask):
+            valid, b = branch(cx)
+            zlo, zhi, ns = self._branch_varint(b)
+            cx.out = _put_varint(cx.out, cursor, zlo, zhi, ns, mask)
+            inner_write(cx, cursor + ns, mask & valid)
+
+        return size, write
+
+    def lower_union(self, t: Union, path: str, region: int):
+        """N-variant union: branch from the Arrow type_ids
+        (≙ ``build_union_encoder``, ``fast_encode.rs:258``)."""
+        arms = []
+        for k, v in enumerate(t.variants):
+            if v.is_null():
+                arms.append(None)
+            else:
+                arms.append(self.lower_type(v, f"{path}/{k}", region))
+
+        def size(cx):
+            s = cx.sizes.get(path + "#uni")
+            if s is None:
+                tid = cx.dv[path + "#tid"]
+                _, _, ns = self._branch_varint(tid)
+                s = ns
+                for k, arm in enumerate(arms):
+                    if arm is not None:
+                        s = s + jnp.where(tid == k, arm[0](cx), 0)
+                cx.sizes[path + "#uni"] = s
+            return s
+
+        def write(cx, cursor, mask):
+            tid = cx.dv[path + "#tid"]
+            zlo, zhi, ns = self._branch_varint(tid)
+            cx.out = _put_varint(cx.out, cursor, zlo, zhi, ns, mask)
+            for k, arm in enumerate(arms):
+                if arm is not None:
+                    arm[1](cx, cursor + ns, mask & (tid == k))
+
+        return size, write
+
+    def lower_repeated(self, t, path: str):
+        """Array/map single-block form ``[count, items..., 0]`` / ``0``.
+
+        Item positions come from one within-row prefix sum over the flat
+        item axis — the TPU replacement for the reference's per-item
+        sequential writes (``fast_encode.rs:518-554``)."""
+        rid = len(self.regions)
+        self.regions.append(path)
+        if isinstance(t, Array):
+            items = [self.lower_type(t.items, path + "/@item", rid)]
+        else:
+            items = [
+                self.lower_type(Primitive("string"), path + "/@key", rid),
+                self.lower_type(t.values, path + "/@val", rid),
+            ]
+
+        def axis(cx):
+            """Per-region item-axis bookkeeping, computed once."""
+            info = cx.item.get(rid)
+            if info is None:
+                counts = cx.dv[path + "#count"]
+                R = counts.shape[0]
+                off = jnp.concatenate(
+                    [jnp.zeros(1, I32), jnp.cumsum(counts, dtype=I32)]
+                )
+                T = cx.dv["#active:%d" % rid].shape[0]
+                j = jnp.arange(T, dtype=I32)
+                row = _row_of(off, R, T)
+                active = j < off[-1]
+                # exact per-item size over the flat axis
+                isize = jnp.zeros(T, I32)
+                for s, _ in items:
+                    isize = isize + s(cx)
+                isize = jnp.where(active, isize, 0)
+                cum = jnp.cumsum(isize)
+                ex = cum - isize  # exclusive
+                row_first = jnp.take(off, row, mode="clip")
+                within = ex - jnp.take(ex, row_first, mode="clip")
+                per_row = jnp.zeros(R, I32).at[row].add(
+                    jnp.where(active, isize, 0), mode="drop"
+                )
+                info = cx.item[rid] = {
+                    "counts": counts, "row": row, "active": active,
+                    "within": within, "per_row": per_row, "isize": isize,
+                }
+            return info
+
+        def size(cx):
+            s = cx.sizes.get(path + "#rep")
+            if s is None:
+                info = axis(cx)
+                counts = info["counts"]
+                zlo, zhi = _zigzag32(counts)
+                ns = _varint_size(zlo, zhi)
+                # [count, items..., 0] — or a bare 0 byte when empty
+                s = jnp.where(counts > 0, ns + info["per_row"] + 1, 1)
+                cx.sizes[path + "#rep"] = s
+            return s
+
+        def write(cx, cursor, mask):
+            info = axis(cx)
+            counts = info["counts"]
+            zlo, zhi = _zigzag32(counts)
+            ns = _varint_size(zlo, zhi)
+            nonempty = mask & (counts > 0)
+            cx.out = _put_varint(cx.out, cursor, zlo, zhi, ns, nonempty)
+            # terminator 0 is the block's last byte (also the only byte
+            # of an empty block)
+            cx.out = _put_byte(
+                cx.out, cursor + size(cx) - 1, jnp.zeros_like(zlo), mask
+            )
+            # items: data begins after the count varint
+            data_start = cursor + ns
+            item_cursor = (
+                jnp.take(data_start, info["row"], mode="clip")
+                + info["within"]
+            )
+            item_mask = info["active"] & jnp.take(
+                nonempty, info["row"], mode="clip"
+            )
+            icur = item_cursor
+            for s, w in items:
+                w(cx, icur, item_mask)
+                icur = icur + jnp.where(item_mask, s(cx), 0)
+
+        return size, write
+
+
+def lower_encoder(ir: AvroType) -> EncProgram:
+    """Lower a top-level record schema to its device encode program.
+    Subset = the decode subset (``gate.is_supported`` minus nested
+    repetition), so both directions gate identically."""
+    if not is_supported(ir):
+        raise UnsupportedOnDevice("schema is outside the fast-path subset")
+    lo = _EncLowering()
+    size, write = lo.lower_record(ir, "", ROWS)
+    return EncProgram(
+        ir=ir,
+        regions=lo.regions,
+        string_cols=lo.string_cols,
+        size=size,
+        write=write,
+    )
+
+
+# ---------------------------------------------------------------------------
+# bulk string payload scatter (after the walk)
+# ---------------------------------------------------------------------------
+
+def _write_string_bytes(cx: _Cx, col: _StrCol):
+    """Copy one column's payload bytes: for every source byte, find its
+    element (scatter-max + cummax over element starts), then scatter to
+    ``dst_start[elem] + position``. One gather + one scatter per column
+    regardless of row count."""
+    path = col.path
+    dst, mask = cx.str_dst[path]
+    src = cx.dv[path + "#src"]     # element start offsets (monotone)
+    lens = cx.dv[path + "#len"]
+    words = cx.dv[path + "#bytes"]
+    n_el = src.shape[0]
+    V = words.shape[0] * 4
+    j = jnp.arange(V, dtype=I32)
+    elem = _row_of(src, n_el, V)
+    pos = j - jnp.take(src, elem, mode="clip")
+    ok = (
+        (pos >= 0)
+        & (pos < jnp.take(lens, elem, mode="clip"))
+        & jnp.take(mask, elem, mode="clip")
+    )
+    byte = jnp.bitwise_and(
+        lax.shift_right_logical(
+            jnp.take(words, lax.shift_right_logical(j, 2), mode="clip"),
+            (jnp.bitwise_and(j, 3) << 3).astype(U32),
+        ),
+        U32(0xFF),
+    )
+    out_idx = jnp.take(dst, elem, mode="clip") + pos
+    cx.out = _put_byte(cx.out, out_idx, byte, ok)
+
+
+# ---------------------------------------------------------------------------
+# host side: Arrow batch → device inputs
+# ---------------------------------------------------------------------------
+
+
+
+class _Extractor:
+    """Walk the schema IR + Arrow arrays, producing the device input
+    dict (same path keys the lowering registered) and a byte-capacity
+    upper bound. Validity/shape errors match the host oracle's
+    (``fallback/encoder.py``): nulls at non-nullable positions, unknown
+    enum symbols and out-of-range union type_ids raise ``ValueError``.
+
+    A ``parent`` validity chain (None = all rows live) tracks which
+    lanes the encoder will actually read — nulls are only an error where
+    the chain is live (a null under a null struct or a non-selected
+    union arm is never encoded, so never an error; same as the oracle,
+    which never visits masked values)."""
+
+    def __init__(self) -> None:
+        self.arrays: Dict[str, Tuple[np.ndarray, int]] = {}  # key → (arr, region)
+        self.byte_bufs: Dict[str, np.ndarray] = {}           # key → u8 buffer
+        self.region_len: Dict[int, int] = {}
+        self.regions: List[str] = [""]
+        self.bound = 0
+
+    def put(self, key: str, arr: np.ndarray, region: int) -> None:
+        self.arrays[key] = (np.ascontiguousarray(arr), region)
+
+    # -- leaf readers (offset-aware) --------------------------------------
+
+    @staticmethod
+    def _valid(arr: pa.Array) -> Optional[np.ndarray]:
+        if arr.null_count == 0:
+            return None
+        return arr.is_valid().to_numpy(zero_copy_only=False)
+
+    @staticmethod
+    def _ints(arr: pa.Array, target: pa.DataType, dtype) -> np.ndarray:
+        import pyarrow.compute as pc
+
+        a = arr if arr.type.equals(target) else arr.cast(target)
+        if a.null_count:
+            a = pc.fill_null(a, 0)
+        return a.to_numpy(zero_copy_only=False).astype(dtype, copy=False)
+
+    def _require_valid(self, arr: pa.Array, path: str,
+                       parent: Optional[np.ndarray]) -> None:
+        """Error on nulls the encoder would actually read."""
+        if not arr.null_count:
+            return
+        dead = ~self._valid(arr)
+        if parent is not None:
+            dead = dead & parent
+        if dead.any():
+            i = int(np.flatnonzero(dead)[0])
+            raise ValueError(
+                f"row {i}: null value for non-nullable Avro position "
+                f"{path or '<top>'!r} (no null union there in the schema)"
+            )
+
+    # -- recursive walk ---------------------------------------------------
+
+    def extract(self, t: AvroType, arr: pa.Array, path: str,
+                region: int, parent: Optional[np.ndarray]) -> None:
+        if isinstance(arr, pa.ChunkedArray):
+            arr = arr.combine_chunks()
+
+        if isinstance(t, Union) and t.is_nullable_pair:
+            valid = self._valid(arr)
+            if valid is None:
+                valid = np.ones(len(arr), bool)
+            self.put(path + "#valid", valid.astype(np.uint8), region)
+            self.bound += len(arr)  # 1-byte branch varint
+            sub = valid if parent is None else (valid & parent)
+            self.extract(t.non_null_variant, arr, path, region, sub)
+            return
+
+        self._require_valid(arr, path, parent)
+
+        if isinstance(t, Primitive):
+            self._extract_primitive(t, arr, path, region)
+            return
+        if isinstance(t, Enum):
+            self._extract_enum(t, arr, path, region, parent)
+            return
+        if isinstance(t, Record):
+            prefix = path + "/" if path else ""
+            sub = parent
+            v = self._valid(arr)
+            if v is not None:
+                sub = v if sub is None else (v & sub)
+            for i, f in enumerate(t.fields):
+                self.extract(f.type, arr.field(i), prefix + f.name,
+                             region, sub)
+            return
+        if isinstance(t, Union):
+            tids = np.frombuffer(
+                arr.buffers()[1], np.int8, count=len(arr) + arr.offset
+            )[arr.offset:].astype(np.int32)
+            live_bad = (tids < 0) | (tids >= len(t.variants))
+            if parent is not None:
+                live_bad = live_bad & parent
+            if live_bad.any():
+                bad = int(tids[live_bad][0])
+                raise ValueError(f"union type_id {bad} out of range")
+            self.put(path + "#tid", tids, region)
+            self.bound += 5 * len(arr)
+            for k, v in enumerate(t.variants):
+                if not v.is_null():
+                    sel = tids == k
+                    sub = sel if parent is None else (sel & parent)
+                    self.extract(v, arr.field(k), f"{path}/{k}", region,
+                                 sub)
+            return
+        if isinstance(t, (Array, Map)):
+            self._extract_repeated(t, arr, path, parent)
+            return
+        raise UnsupportedOnDevice(f"type {type(t).__name__} at {path!r}")
+
+    def _extract_primitive(self, t: Primitive, arr, path, region) -> None:
+        name = t.name
+        if name == "null":
+            return
+        if name == "int":
+            self.put(path + "#v", self._ints(arr, pa.int32(), np.int32), region)
+            self.bound += 5 * len(arr)
+        elif name == "long":
+            v = self._ints(arr, pa.int64(), np.int64)
+            u = v.view(np.uint64)
+            self.put(path + "#v:lo", (u & 0xFFFFFFFF).astype(np.uint32), region)
+            self.put(path + "#v:hi", (u >> 32).astype(np.uint32), region)
+            self.bound += 10 * len(arr)
+        elif name == "float":
+            import pyarrow.compute as pc
+
+            a = pc.fill_null(arr, 0.0) if arr.null_count else arr
+            self.put(
+                path + "#v",
+                a.to_numpy(zero_copy_only=False).astype(np.float32,
+                                                        copy=False),
+                region,
+            )
+            self.bound += 4 * len(arr)
+        elif name == "double":
+            import pyarrow.compute as pc
+
+            a = pc.fill_null(arr, 0.0) if arr.null_count else arr
+            u = a.to_numpy(zero_copy_only=False).astype(
+                np.float64, copy=False
+            ).view(np.uint64)
+            self.put(path + "#v:lo", (u & 0xFFFFFFFF).astype(np.uint32), region)
+            self.put(path + "#v:hi", (u >> 32).astype(np.uint32), region)
+            self.bound += 8 * len(arr)
+        elif name == "boolean":
+            self.put(path + "#v", self._ints(arr, pa.uint8(), np.uint8), region)
+            self.bound += len(arr)
+        elif name == "string":
+            self._extract_string(arr, path, region)
+        else:
+            raise UnsupportedOnDevice(f"primitive {name!r} at {path!r}")
+
+    def _extract_string(self, arr, path, region) -> None:
+        n = len(arr)
+        off_buf = arr.buffers()[1]
+        if off_buf is None:
+            offs = np.zeros(n + 1, np.int32)
+        else:
+            offs = np.frombuffer(off_buf, np.int32,
+                                 count=n + arr.offset + 1)[arr.offset:]
+        base, end = int(offs[0]), int(offs[-1])
+        val_buf = arr.buffers()[2]
+        vals = (
+            np.frombuffer(val_buf, np.uint8, count=end)[base:end]
+            if val_buf is not None and end > base
+            else np.zeros(0, np.uint8)
+        )
+        src = (offs[:-1] - base).astype(np.int32)
+        lens = np.diff(offs).astype(np.int32)
+        self.put(path + "#src", src, region)
+        self.put(path + "#len", lens, region)
+        self.byte_bufs[path + "#bytes"] = vals
+        self.bound += 5 * n + int(lens.sum())
+
+    def _extract_enum(self, t: Enum, arr, path, region,
+                      parent: Optional[np.ndarray]) -> None:
+        import pyarrow.compute as pc
+
+        idx = pc.index_in(arr, value_set=pa.array(list(t.symbols), pa.utf8()))
+        missing = pc.and_(pc.is_null(idx), arr.is_valid()).to_numpy(
+            zero_copy_only=False
+        )
+        if parent is not None:
+            missing = missing & parent
+        if missing.any():
+            i = int(np.flatnonzero(missing)[0])
+            raise ValueError(
+                f"value {arr[i].as_py()!r} is not a symbol of enum "
+                f"{t.fullname}"
+            )
+        self.put(
+            path + "#v",
+            pc.fill_null(idx, 0).to_numpy(zero_copy_only=False)
+            .astype(np.int32, copy=False),
+            region,
+        )
+        self.bound += 5 * len(arr)
+
+    def _extract_repeated(self, t, arr, path,
+                          parent: Optional[np.ndarray]) -> None:
+        rid = len(self.regions)
+        self.regions.append(path)
+        n = len(arr)
+        offs = np.frombuffer(
+            arr.offsets.buffers()[1], np.int32,
+            count=n + arr.offsets.offset + 1,
+        )[arr.offsets.offset:]
+        # RAW counts: the device derives the flat item-axis mapping from
+        # cumsum(counts), which must mirror the Arrow child layout even
+        # at rows the walk later masks out (a null row may still own a
+        # nonzero offset range)
+        counts = np.diff(offs).astype(np.int32)
+        base, end = int(offs[0]), int(offs[-1])
+        self.put(path + "#count", counts, ROWS)
+        self.region_len[rid] = end - base
+        self.bound += 7 * n  # count varint (≤5) + terminator + slack
+        # lift the row validity chain onto the item axis
+        live = self._valid(arr)
+        if parent is not None:
+            live = parent if live is None else (live & parent)
+        item_parent = (
+            None if live is None
+            else np.repeat(live, counts)
+        )
+        if isinstance(t, Array):
+            child = arr.values.slice(base, end - base)
+            self.extract(t.items, child, path + "/@item", rid, item_parent)
+        else:
+            keys = arr.keys.slice(base, end - base)
+            vals = arr.items.slice(base, end - base)
+            self._require_valid(keys, path + "/@key", item_parent)
+            self._extract_string(keys, path + "/@key", rid)
+            self.extract(t.values, vals, path + "/@val", rid, item_parent)
+
+
+def extract_batch(prog: EncProgram, batch: pa.RecordBatch,
+                  ir: Record) -> Tuple[Dict[str, np.ndarray], int]:
+    """Arrow batch → padded device-input dict + output byte bound.
+
+    Columns are matched by NAME (missing → error, extras ignored),
+    exactly like the oracle and the reference
+    (``serialization_containers.rs:248-267``)."""
+    from ..fallback.encoder import _types_compatible
+    from ..schema.arrow_map import to_arrow_field
+
+    ex = _Extractor()
+    cols = []
+    for f in ir.fields:
+        idx = batch.schema.get_field_index(f.name)
+        if idx == -1:
+            raise ValueError(
+                f"record batch is missing column {f.name!r} required by "
+                f"schema"
+            )
+        expected = to_arrow_field(f.type, name=f.name, nullable=False).type
+        actual = batch.schema.field(idx).type
+        if not _types_compatible(actual, expected):
+            raise ValueError(
+                f"column {f.name!r} has Arrow type {actual}, but the Avro "
+                f"schema requires {expected}"
+            )
+        cols.append(batch.column(idx))
+    struct = pa.StructArray.from_arrays(
+        cols, names=[f.name for f in ir.fields]
+    ) if cols else pa.array([{}] * batch.num_rows, pa.struct([]))
+    ex.extract(ir, struct, "", ROWS, None)
+
+    if ex.regions != prog.regions:  # pragma: no cover — same walk order
+        raise AssertionError("extractor/lowering region mismatch")
+
+    n = batch.num_rows
+    ex.region_len[ROWS] = n
+    dv: Dict[str, np.ndarray] = {}
+    pads = {
+        rid: bucket_len(max(ln, 1), minimum=8) for rid, ln in ex.region_len.items()
+    }
+    for rid, ln in ex.region_len.items():
+        act = np.zeros(pads[rid], np.uint8)
+        act[:ln] = 1
+        dv["#active:%d" % rid] = act
+    for key, (arr, rid) in ex.arrays.items():
+        P = pads[rid]
+        if len(arr) < P:
+            if key.endswith("#src"):
+                # pad with an out-of-range sentinel so padded elements
+                # never win the byte→element scatter-max mapping
+                padded = np.full(P, _BIG, arr.dtype)
+            else:
+                padded = np.zeros(P, arr.dtype)
+            padded[: len(arr)] = arr
+            arr = padded
+        dv[key] = arr
+    for key, buf in ex.byte_bufs.items():
+        V = bucket_len(max(len(buf), 4), minimum=16)
+        if len(buf) < V:
+            buf = np.concatenate([buf, np.zeros(V - len(buf), np.uint8)])
+        dv[key] = np.ascontiguousarray(buf).view(np.uint32)
+    return dv, max(ex.bound, 16)
+
+
+# ---------------------------------------------------------------------------
+# the encoder object
+# ---------------------------------------------------------------------------
+
+class DeviceEncoder:
+    """Per-schema encode pipeline: one jitted launch per (shape-bucket)."""
+
+    def __init__(self, ir: Record, arrow_schema: pa.Schema):
+        import jax  # deferred, like DeviceDecoder
+
+        from .decode import _enable_persistent_cache
+
+        _enable_persistent_cache(jax)
+        self._jax = jax
+        self.ir = ir
+        self.arrow_schema = arrow_schema
+        self.prog = lower_encoder(ir)  # raises UnsupportedOnDevice
+        self._fn = jax.jit(self._program(), static_argnums=1)
+        self._seen_shapes: set = set()
+
+    def _program(self):
+        prog = self.prog
+        jax = self._jax
+
+        def run(dv, cap: int):
+            out = jnp.zeros(cap, jnp.uint8)
+            cx = _Cx(dv, out)
+            active = dv["#active:0"].astype(bool)
+            row_sizes = jnp.where(active, prog.size(cx), 0)
+            cum = jnp.cumsum(row_sizes, dtype=I32)
+            start = cum - row_sizes
+            prog.write(cx, start, active)
+            for col in prog.string_cols:
+                _write_string_bytes(cx, col)
+            return jnp.concatenate(
+                [cx.out, lax.bitcast_convert_type(row_sizes, jnp.uint8)
+                 .reshape(-1)]
+            )
+
+        return run
+
+    def encode(self, batch: pa.RecordBatch) -> pa.Array:
+        """Encode every row as one Avro datum → BinaryArray whose value
+        buffer is the device output, zero-copy
+        (≙ ``serialize_chunk``, ``fast_encode.rs:27-52``)."""
+        import time
+
+        from ..runtime import metrics
+
+        n = batch.num_rows
+        if n == 0:
+            return pa.array([], pa.binary())
+        with metrics.timer("encode.extract_s"):
+            dv, bound = extract_batch(self.prog, batch, self.ir)
+        if bound >= (1 << 30):
+            # int32 cursors AND the _BIG drop-sentinel both require the
+            # output to stay under 2^30 bytes; the codec splits the batch
+            from .decode import BatchTooLarge
+
+            raise BatchTooLarge(n, bound)
+        cap = bucket_len(bound, minimum=64)
+        jax = self._jax
+        shape_key = (cap,) + tuple(
+            sorted((k, v.shape) for k, v in dv.items())
+        )
+        fresh = shape_key not in self._seen_shapes
+        self._seen_shapes.add(shape_key)
+        metrics.inc(
+            "encode.h2d_bytes", sum(v.nbytes for v in dv.values())
+        )
+        t0 = time.perf_counter()
+        res = self._fn(dv, cap)
+        res.block_until_ready()
+        dt = time.perf_counter() - t0
+        if fresh:
+            metrics.inc("encode.compiles")
+            metrics.inc("encode.compile_launch_s", dt)
+        else:
+            metrics.inc("encode.launches")
+            metrics.inc("encode.launch_s", dt)
+        with metrics.timer("encode.d2h_s"):
+            blob = np.asarray(jax.device_get(res))
+        metrics.inc("encode.d2h_bytes", blob.nbytes)
+        R = dv["#active:0"].shape[0]
+        sizes = blob[cap : cap + 4 * R].view(np.int32)[:n]
+        offsets = np.zeros(n + 1, np.int32)
+        np.cumsum(sizes, out=offsets[1:])
+        total = int(offsets[-1])
+        return pa.Array.from_buffers(
+            pa.binary(), n,
+            [None, pa.py_buffer(offsets),
+             pa.py_buffer(np.ascontiguousarray(blob[:total]))],
+        )

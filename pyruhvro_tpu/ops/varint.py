@@ -50,7 +50,7 @@ __all__ = [
 U32 = jnp.uint32
 
 # per-lane error bits, OR-accumulated during the walk and checked on host
-ERR_VARINT = 1 << 0        # varint longer than 10 bytes
+ERR_VARINT = 1 << 0        # varint longer than the wire maximum (10 bytes)
 ERR_NEG_LEN = 1 << 1       # negative string/bytes length
 ERR_OVERRUN = 1 << 2       # cursor ran past the record's end
 ERR_BAD_BRANCH = 1 << 3    # union branch index out of range
@@ -142,13 +142,16 @@ def read_varint64(words, cursor, mask):
     return _read_varint(words, cursor, mask, 10)
 
 
-def read_varint32(words, cursor, mask):
-    """5-byte varint for quantities that must fit a record anyway — union
-    branches, enum indices, string lengths, array/map block counts. A
-    longer varint encodes a value that could not be in-bounds, so it
-    surfaces as ERR_VARINT (→ MalformedAvro) rather than paying the
-    10-byte gather chain on every hot read. 3 word gathers."""
-    return _read_varint(words, cursor, mask, 5)
+# Varint for quantities that must fit 32 bits after decode — union
+# branches, enum indices, string lengths, array/map block counts. The
+# full 10-byte wire maximum is read, exactly like the host path's
+# ``read_long`` (and the reference's ``read_zigzag_long``,
+# ``fast_decode.rs:855-869``), so legal-but-non-minimal LEB128 encodings
+# (zero-padded small values) decode instead of erroring; out-of-range
+# *values* are rejected by each caller's ``hi``-word check. Deliberately
+# the same reader as read_varint64 — the distinct name marks call sites
+# whose callers enforce a 32-bit range.
+read_varint32 = read_varint64
 
 
 def zigzag_decode_pair(lo, hi):

@@ -1,0 +1,257 @@
+"""Sharded decode: the fused pipeline ``shard_map``-ped over chunks.
+
+One multi-device launch decodes all chunks: inputs are stacked
+``[D, ...]`` arrays sharded over the mesh's ``"chunks"`` axis, each
+device runs the per-chunk pipeline (``DeviceDecoder.build_pipeline``) on
+its shard, and one transfer fetches the ``[D, blob]`` result. The host
+then splits each device's blob and assembles one RecordBatch per chunk —
+exactly the reference's chunked return shape (one batch per chunk, never
+concatenated, ``deserialize.rs:90-121``).
+
+Capacity handling is shared with the single-device path
+(``DeviceDecoder.caps_snapshot`` / ``grow_caps``): caps are global across
+shards — every shard runs the same compiled program — and the retry
+reductions are max-reduced across shards on the host.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+import pyarrow as pa
+
+from ..fallback.io import MalformedAvro
+from ..ops.decode import (
+    BatchTooLarge,
+    DeviceDecoder,
+    pad_views,
+    split_blob,
+)
+from ..ops.fieldprog import ROWS
+from ..ops.varint import ERR_ITEM_OVERFLOW, ERR_NAMES
+from ..runtime.chunking import chunk_bounds
+from ..runtime.pack import bucket_len, concat_records
+
+__all__ = ["ShardedDecoder", "chunk_mesh"]
+
+
+def _shard_map(jax):
+    """``jax.shard_map`` across JAX versions."""
+    fn = getattr(jax, "shard_map", None)
+    if fn is not None:
+        return fn
+    from jax.experimental.shard_map import shard_map  # jax < 0.4.35
+
+    return shard_map
+
+
+def chunk_mesh(devices=None, n_devices: Optional[int] = None):
+    """A 1-D mesh over the ``"chunks"`` axis (the only parallel axis this
+    workload has — chunks are independent, SURVEY.md §2)."""
+    import jax
+
+    devs = list(devices) if devices is not None else jax.devices()
+    if n_devices is not None:
+        if len(devs) < n_devices:
+            raise RuntimeError(
+                f"need {n_devices} devices for the chunk mesh, "
+                f"have {len(devs)} ({devs[0].platform})"
+            )
+        devs = devs[:n_devices]
+    return jax.sharding.Mesh(np.array(devs), ("chunks",))
+
+
+class ShardedDecoder:
+    """Decode Avro datums in ``D`` mesh-sharded chunks, one launch.
+
+    ≙ the chunk fan-out of ``per_datum_deserialize_threaded``
+    (``deserialize.rs:90-121``) with devices in place of threads.
+    """
+
+    def __init__(self, ir=None, *, base: Optional[DeviceDecoder] = None,
+                 mesh=None, devices=None, n_devices: Optional[int] = None):
+        if base is None:
+            if ir is None:
+                raise ValueError("need a schema IR or a DeviceDecoder")
+            base = DeviceDecoder(ir)
+        self.base = base
+        self._jax = base._jax
+        self.mesh = mesh if mesh is not None else chunk_mesh(
+            devices, n_devices
+        )
+        self.D = int(self.mesh.devices.size)
+        self._cache: Dict[tuple, tuple] = {}
+        self._lock = threading.Lock()
+
+    # -- compiled sharded launch ------------------------------------------
+
+    def _sharded_fn(self, R: int, B: int, item_caps: Tuple[int, ...],
+                    tot_caps: Tuple[int, ...]):
+        """Jit of ``shard_map(per-chunk pipeline)`` over the mesh, cached
+        per (R, B, caps) bucket like the single-device pipeline."""
+        key = (R, B, item_caps, tot_caps)
+        hit = self._cache.get(key)
+        if hit is not None:
+            return hit
+        jax = self._jax
+        pipe, layout = self.base.build_pipeline(R, B, item_caps, tot_caps)
+        P = jax.sharding.PartitionSpec
+
+        def per_shard(words, starts, lengths, n):
+            # local block: leading chunk axis of size 1
+            return pipe(words[0], starts[0], lengths[0], n[0])[None]
+
+        smap = _shard_map(jax)
+        kwargs = dict(
+            mesh=self.mesh,
+            in_specs=(P("chunks"), P("chunks"), P("chunks"), P("chunks")),
+            out_specs=P("chunks"),
+        )
+        # the body is collective-free (chunks are independent), so the
+        # varying-manual-axes/replication check only costs false
+        # positives on while_loop carries initialized inside the body;
+        # the flag name moved across JAX versions
+        try:
+            fn = smap(per_shard, check_vma=False, **kwargs)
+        except TypeError:
+            fn = smap(per_shard, check_rep=False, **kwargs)
+        pair = (jax.jit(fn), layout)
+        with self._lock:
+            self._cache[key] = pair
+        return pair
+
+    # -- orchestration -----------------------------------------------------
+
+    def decode_to_chunk_columns(self, data: Sequence[bytes]):
+        """Decode into exactly ``D`` chunks (reference slicing: even, with
+        the remainder in the LAST chunk). Returns a list of
+        ``(host_columns, n_rows, meta)`` per chunk — the same triple the
+        single-device path produces, ready for ``arrow_build``."""
+        n_all = len(data)
+        bounds = chunk_bounds(n_all, self.D)
+        # fewer records than devices: pad with empty shards so the launch
+        # shape stays [D, ...] (inactive lanes decode nothing)
+        while len(bounds) < self.D:
+            bounds.append((n_all, n_all))
+
+        packs = []
+        for a, b in bounds:
+            flat, offsets = concat_records(data[a:b])
+            packs.append((flat, offsets, b - a))
+        max_total = max(int(p[1][-1]) for p in packs)
+        max_rows = max(p[2] for p in packs)
+        if max_total > (1 << 30):
+            raise BatchTooLarge(n_all, max_total)
+        B = bucket_len(max(max_total, 4), minimum=16)
+        R = bucket_len(max(max_rows, 1), minimum=8)
+        self.base.seed_caps_from_sample(data, R)
+
+        D = self.D
+        words = np.empty((D, B // 4), np.uint32)
+        starts = np.empty((D, R), np.int32)
+        lengths = np.empty((D, R), np.int32)
+        ns = np.empty(D, np.int32)
+        flats = []
+        for d, (flat, offsets, n) in enumerate(packs):
+            w, s, ln, fpad = pad_views(flat, offsets, n, R, B)
+            words[d], starts[d], lengths[d], ns[d] = w, s, ln, n
+            flats.append(fpad)
+
+        jax = self._jax
+        prog = self.base.prog
+        # place the shards once; cap retries relaunch without re-sending
+        # the inputs over the interconnect
+        spec = jax.sharding.NamedSharding(
+            self.mesh, jax.sharding.PartitionSpec("chunks")
+        )
+        words_d = jax.device_put(words, spec)
+        starts_d = jax.device_put(starts, spec)
+        lengths_d = jax.device_put(lengths, spec)
+        ns_d = jax.device_put(ns, spec)
+        hosts = None
+        for _attempt in range(24):
+            item_caps, tot_caps = self.base.caps_snapshot(R)
+            fn, layout = self._sharded_fn(R, B, item_caps, tot_caps)
+            blob = np.asarray(
+                jax.device_get(fn(words_d, starts_d, lengths_d, ns_d))
+            )
+            hosts = [split_blob(blob[d], layout) for d in range(D)]
+            red_max = {}
+            red_sum = {}
+            for rid, path in enumerate(prog.regions):
+                if rid == ROWS:
+                    continue
+                red_max[rid] = max(
+                    int(h["#red:max:" + path][0]) for h in hosts
+                )
+                # tot caps bound the PER-SHARD item total, so the shard
+                # max (not the sum) is the right growth signal
+                red_sum[rid] = max(
+                    int(h["#red:sum:" + path][0]) for h in hosts
+                )
+            if not self.base.grow_caps(R, item_caps, tot_caps,
+                                       red_max, red_sum):
+                break
+        else:
+            raise MalformedAvro("array/map item capacity did not converge")
+
+        for d, h in enumerate(hosts):
+            if h["#red:err"][0]:
+                self._raise_shard_error(
+                    words[d], starts[d], lengths[d], ns[d],
+                    R, B, item_caps, bounds[d][0],
+                )
+
+        out = []
+        for d, h in enumerate(hosts):
+            meta = {"item_totals": {}, "flat": flats[d]}
+            for rid, path in enumerate(prog.regions):
+                if rid != ROWS:
+                    meta["item_totals"][path] = int(
+                        h["#red:sum:" + path][0]
+                    )
+            out.append((h, int(ns[d]), meta))
+        return out
+
+    def _raise_shard_error(self, words, starts, lengths, n, R, B,
+                           item_caps, base_row: int):
+        """Re-run the (lazily compiled) walk-only error pass on the one
+        failing shard — single device, rare path — and report the GLOBAL
+        record index."""
+        jax = self._jax
+        err = np.asarray(
+            jax.device_get(
+                self.base._err_fn(R, B, item_caps)(
+                    words, starts, lengths, np.int32(n)
+                )
+            )
+        )[: int(n)]
+        bad = err & ~np.uint32(ERR_ITEM_OVERFLOW)
+        idx = np.flatnonzero(bad)
+        if idx.size == 0:  # pragma: no cover — err flag implies a bad lane
+            raise MalformedAvro("device reported a malformed record")
+        i = int(idx[0])
+        v = int(bad[i])
+        bit = v & -v
+        raise MalformedAvro(
+            f"record {base_row + i}: "
+            f"{ERR_NAMES.get(bit, f'error bit {bit:#x}')}"
+        )
+
+    def decode(self, data: Sequence[bytes], ir=None,
+               arrow_schema: Optional[pa.Schema] = None
+               ) -> List[pa.RecordBatch]:
+        """Full sharded decode → one RecordBatch per mesh chunk."""
+        from ..ops.arrow_build import build_record_batch
+
+        ir = ir if ir is not None else self.base.prog.ir
+        if arrow_schema is None:
+            from ..schema.arrow_map import to_arrow_schema
+
+            arrow_schema = to_arrow_schema(ir)
+        return [
+            build_record_batch(ir, arrow_schema, host, n, meta)
+            for host, n, meta in self.decode_to_chunk_columns(data)
+        ]

@@ -1,0 +1,61 @@
+"""Lightweight process-wide phase counters (observability).
+
+The reference has no runtime metrics (SURVEY.md §5); this is the one
+subsystem the TPU build adds beyond parity, because VERDICT r02 showed
+why it must exist: compile counts, launch times and transfer volumes are
+invisible in end-to-end timings, and on a high-latency interconnect they
+ARE the performance story. ``bench.py`` snapshots these into
+``BENCH_DETAILS.json``; ``scripts/profile_decode.py`` prints them per
+phase alongside a ``jax.profiler`` trace.
+
+Counters are cumulative floats keyed by ``"component.event"``
+(e.g. ``decode.compiles``, ``decode.d2h_bytes``). Cheap enough to stay
+always-on: one lock + dict add per event, host-side only.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import defaultdict
+from typing import Dict
+
+__all__ = ["inc", "snapshot", "reset", "timer"]
+
+_lock = threading.Lock()
+_counters: Dict[str, float] = defaultdict(float)
+
+
+def inc(key: str, value: float = 1.0) -> None:
+    with _lock:
+        _counters[key] += value
+
+
+def snapshot() -> Dict[str, float]:
+    with _lock:
+        return dict(_counters)
+
+
+def reset() -> None:
+    with _lock:
+        _counters.clear()
+
+
+class timer:
+    """``with timer("decode.pack_s"): ...`` — adds elapsed seconds."""
+
+    __slots__ = ("key", "_t0")
+
+    def __init__(self, key: str):
+        self.key = key
+
+    def __enter__(self):
+        import time
+
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        import time
+
+        inc(self.key, time.perf_counter() - self._t0)
+        return False

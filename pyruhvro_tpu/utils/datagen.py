@@ -28,7 +28,13 @@ from ..schema.model import (
 )
 from ..fallback.encoder import compile_writer
 
-__all__ = ["random_value", "random_datums", "kafka_style_datums", "KAFKA_SCHEMA_JSON"]
+__all__ = [
+    "random_value",
+    "random_datums",
+    "kafka_style_datums",
+    "KAFKA_SCHEMA_JSON",
+    "CRITERION_SHAPES",
+]
 
 _WORDS = (
     "alpha bravo charlie delta echo foxtrot golf hotel india juliett kilo lima "
@@ -111,6 +117,33 @@ def random_datums(t: AvroType, n: int, seed: int = 0) -> List[bytes]:
         writer(buf, random_value(t, rng))
         out.append(bytes(buf))
     return out
+
+
+# The four schema shapes of the reference's criterion benchmark suite
+# (``ruhvro/benches/common/mod.rs:37-165``), reproduced by shape (not
+# copied): flat primitives, nullable primitives, a nested struct, and an
+# array+map pair. bench.py runs each × {1k, 10k} rows × backends.
+CRITERION_SHAPES = {
+    "flat_primitives": """{"type":"record","name":"FlatPrimitives","fields":[
+        {"name":"id","type":"long"},{"name":"count","type":"int"},
+        {"name":"score","type":"double"},{"name":"weight","type":"float"},
+        {"name":"flag","type":"boolean"},{"name":"label","type":"string"}]}""",
+    "nullable_primitives": """{"type":"record","name":"NullablePrimitives","fields":[
+        {"name":"id","type":["null","long"]},
+        {"name":"label","type":["null","string"]},
+        {"name":"score","type":["null","double"]},
+        {"name":"flag","type":["null","boolean"]}]}""",
+    "nested_struct": """{"type":"record","name":"Outer","fields":[
+        {"name":"id","type":"long"},
+        {"name":"inner","type":{"type":"record","name":"Inner","fields":[
+            {"name":"name","type":"string"},
+            {"name":"value","type":["null","int"]}]}},
+        {"name":"maybe","type":["null",{"type":"record","name":"Inner2",
+            "fields":[{"name":"x","type":"double"}]}]}]}""",
+    "array_and_map": """{"type":"record","name":"ArrayAndMap","fields":[
+        {"name":"tags","type":{"type":"array","items":"string"}},
+        {"name":"metrics","type":{"type":"map","values":"double"}}]}""",
+}
 
 
 KAFKA_SCHEMA_JSON = """\

@@ -1,0 +1,173 @@
+"""Differential + wire-compat tests for the device encode kernel.
+
+≙ the reference's encoder test strategy (``fast_encode.rs:614-637``):
+(a) device bytes must equal the host-oracle encoder's bytes exactly
+(both emit minimal varints and single-block arrays, so byte equality —
+stronger than the reference's decode-back check — is the contract), and
+(b) wire compatibility: device-encoded bytes decoded by the independent
+host reader reproduce the original batch.
+"""
+
+import pyarrow as pa
+import pytest
+
+import pyruhvro_tpu as pv
+from pyruhvro_tpu.fallback.decoder import decode_to_record_batch
+from pyruhvro_tpu.fallback.encoder import encode_record_batch
+from pyruhvro_tpu.ops import UnsupportedOnDevice
+from pyruhvro_tpu.ops.encode import DeviceEncoder
+from pyruhvro_tpu.schema.cache import get_or_parse_schema
+from pyruhvro_tpu.utils.datagen import (
+    KAFKA_SCHEMA_JSON,
+    kafka_style_datums,
+    random_datums,
+)
+
+from test_device_decode import SHAPES
+
+
+def _encoder(schema: str) -> DeviceEncoder:
+    entry = get_or_parse_schema(schema)
+    return entry.get_extra(
+        "test_device_encoder",
+        lambda: DeviceEncoder(entry.ir, entry.arrow_schema),
+    )
+
+
+def _batch(schema: str, datums) -> pa.RecordBatch:
+    entry = get_or_parse_schema(schema)
+    return decode_to_record_batch(datums, entry.ir, entry.arrow_schema)
+
+
+def _diff_encode(schema: str, datums) -> None:
+    entry = get_or_parse_schema(schema)
+    batch = _batch(schema, datums)
+    got = [bytes(x) for x in _encoder(schema).encode(batch).to_pylist()]
+    want = encode_record_batch(batch, entry.ir)
+    assert got == want
+    # wire-compat: our bytes through the independent host reader, then
+    # re-encoded — byte-level fixpoint (Arrow `.equals` is NaN-hostile,
+    # so compare on the canonical wire form instead)
+    back = decode_to_record_batch(got, entry.ir, entry.arrow_schema)
+    assert encode_record_batch(back, entry.ir) == want
+
+
+@pytest.mark.parametrize("shape", sorted(SHAPES))
+def test_encode_matches_oracle(shape):
+    entry = get_or_parse_schema(SHAPES[shape])
+    _diff_encode(SHAPES[shape], random_datums(entry.ir, 151, seed=61))
+
+
+def test_encode_matches_oracle_kafka():
+    _diff_encode(KAFKA_SCHEMA_JSON, kafka_style_datums(300, seed=67))
+
+
+def test_encode_empty_batch():
+    out = _encoder(SHAPES["flat"]).encode(
+        _batch(SHAPES["flat"], [])
+    )
+    assert len(out) == 0
+
+
+def test_encode_single_row():
+    entry = get_or_parse_schema(SHAPES["map"])
+    _diff_encode(SHAPES["map"], random_datums(entry.ir, 1, seed=71))
+
+
+def test_encode_sliced_batch():
+    # Arrow offsets ≠ 0 (the chunked serialize path slices batches)
+    schema = KAFKA_SCHEMA_JSON
+    entry = get_or_parse_schema(schema)
+    batch = _batch(schema, kafka_style_datums(90, seed=73))
+    sl = batch.slice(17, 41)
+    got = [bytes(x) for x in _encoder(schema).encode(sl).to_pylist()]
+    want = encode_record_batch(sl, entry.ir)
+    assert got == want
+
+
+def test_encode_extreme_varints():
+    schema = SHAPES["flat"]
+    entry = get_or_parse_schema(schema)
+    from pyruhvro_tpu.fallback.encoder import compile_writer
+
+    w = compile_writer(entry.ir)
+    rows = [
+        {"a": v, "b": b, "c": c, "d": d, "e": e, "s": s}
+        for v, b, c, d, e, s in [
+            ((1 << 63) - 1, (1 << 31) - 1, 1e308, 3.4e38, True, ""),
+            (-(1 << 63), -(1 << 31), -1e-308, -1.2e-38, False, "x" * 300),
+            (0, 0, 0.0, -0.0, False, "héllo wörld é中文"),
+            (-1, -1, float("inf"), float("-inf"), True, "y"),
+            (1, 1, float("nan"), 0.0, False, ""),
+        ]
+    ]
+    datums = []
+    for r in rows:
+        buf = bytearray()
+        w(buf, r)
+        datums.append(bytes(buf))
+    _diff_encode(schema, datums)
+
+
+def test_encode_empty_and_long_collections():
+    schema = SHAPES["arr"]
+    entry = get_or_parse_schema(schema)
+    from pyruhvro_tpu.fallback.encoder import compile_writer
+
+    w = compile_writer(entry.ir)
+    rows = [
+        {"xs": [], "ys": [], "na": None},
+        {"xs": [f"item-{j}" for j in range(200)], "ys": list(range(100)),
+         "na": (1, [])},
+        {"xs": [""], "ys": [0], "na": (1, [1, -1])},
+    ]
+    datums = []
+    for r in rows:
+        buf = bytearray()
+        w(buf, r)
+        datums.append(bytes(buf))
+    _diff_encode(schema, datums)
+
+
+def test_encode_missing_column_errors():
+    batch = pa.RecordBatch.from_pydict({"wrong": pa.array([1, 2])})
+    with pytest.raises(ValueError, match="missing column"):
+        _encoder(SHAPES["flat"]).encode(batch)
+
+
+def test_encode_null_in_non_nullable_errors():
+    entry = get_or_parse_schema(SHAPES["flat"])
+    batch = _batch(SHAPES["flat"], random_datums(entry.ir, 3, seed=79))
+    cols = list(batch.columns)
+    i = batch.schema.get_field_index("a")
+    cols[i] = pa.array([1, None, 3], pa.int64())
+    bad = pa.RecordBatch.from_arrays(cols, schema=batch.schema)
+    with pytest.raises(ValueError, match="null"):
+        _encoder(SHAPES["flat"]).encode(bad)
+
+
+def test_api_serialize_device_matches_host():
+    # the public serialize entry point routes through the device kernel
+    # (backend='tpu') and must agree with the host path per chunk
+    datums = kafka_style_datums(130, seed=83)
+    batch = pv.deserialize_array(datums, KAFKA_SCHEMA_JSON, backend="host")
+    dev = pv.serialize_record_batch(batch, KAFKA_SCHEMA_JSON, 4,
+                                    backend="tpu")
+    host = pv.serialize_record_batch(batch, KAFKA_SCHEMA_JSON, 4,
+                                     backend="host")
+    assert len(dev) == len(host) == 4
+    for d, h in zip(dev, host):
+        assert d.to_pylist() == h.to_pylist()
+
+
+def test_device_roundtrip():
+    # device encode → device decode closes the loop on-device
+    datums = kafka_style_datums(64, seed=89)
+    batch = pv.deserialize_array(datums, KAFKA_SCHEMA_JSON, backend="tpu")
+    chunks = pv.serialize_record_batch(batch, KAFKA_SCHEMA_JSON, 1,
+                                       backend="tpu")
+    redecoded = pv.deserialize_array(
+        [bytes(x) for x in chunks[0].to_pylist()],
+        KAFKA_SCHEMA_JSON, backend="tpu",
+    )
+    assert redecoded.equals(batch)

@@ -1,0 +1,150 @@
+"""Multi-chip sharded decode vs the single-device path and host oracle.
+
+Runs on the spoofed 8-device CPU mesh (tests/conftest.py sets
+``--xla_force_host_platform_device_count=8``) — SURVEY.md §4.7's
+prescription for testing pmap/shard_map configs without hardware. The
+differential contract (≙ ``fast_decode.rs:945-953``) extends to the
+mesh: every sharded chunk must equal the corresponding slice of the
+host-oracle batch.
+"""
+
+import jax
+import pytest
+
+import pyruhvro_tpu as pv
+from pyruhvro_tpu.fallback.decoder import MalformedAvro, decode_to_record_batch
+from pyruhvro_tpu.fallback.io import write_long
+from pyruhvro_tpu.parallel import ShardedDecoder, chunk_mesh
+from pyruhvro_tpu.schema.cache import get_or_parse_schema
+from pyruhvro_tpu.utils.datagen import (
+    KAFKA_SCHEMA_JSON,
+    kafka_style_datums,
+    random_datums,
+)
+
+from test_device_decode import SHAPES
+
+pytestmark = pytest.mark.skipif(
+    len(jax.devices()) < 2, reason="needs the spoofed multi-device mesh"
+)
+
+
+def _sharded_diff(schema: str, datums, n_devices: int) -> None:
+    entry = get_or_parse_schema(schema)
+    sharded = ShardedDecoder(entry.ir, mesh=chunk_mesh(n_devices=n_devices))
+    batches = sharded.decode(datums, entry.ir, entry.arrow_schema)
+    assert len(batches) == n_devices
+    assert sum(b.num_rows for b in batches) == len(datums)
+    oracle = decode_to_record_batch(datums, entry.ir, entry.arrow_schema)
+    row = 0
+    for b in batches:
+        assert b.schema.equals(oracle.schema)
+        assert b.equals(oracle.slice(row, b.num_rows)), f"chunk at row {row}"
+        row += b.num_rows
+
+
+@pytest.mark.parametrize("shape", sorted(SHAPES))
+def test_sharded_matches_oracle(shape):
+    entry = get_or_parse_schema(SHAPES[shape])
+    _sharded_diff(SHAPES[shape], random_datums(entry.ir, 157, seed=29), 8)
+
+
+def test_sharded_matches_oracle_kafka():
+    _sharded_diff(KAFKA_SCHEMA_JSON, kafka_style_datums(200, seed=31), 8)
+
+
+@pytest.mark.parametrize("n_devices", [2, 4, 8])
+def test_sharded_mesh_sizes(n_devices):
+    entry = get_or_parse_schema(SHAPES["flat"])
+    _sharded_diff(
+        SHAPES["flat"], random_datums(entry.ir, 67, seed=37), n_devices
+    )
+
+
+def test_sharded_fewer_rows_than_devices():
+    # empty shards must pad the launch, not shrink the mesh
+    entry = get_or_parse_schema(SHAPES["nested"])
+    _sharded_diff(SHAPES["nested"], random_datums(entry.ir, 3, seed=41), 8)
+
+
+def test_sharded_single_record():
+    entry = get_or_parse_schema(SHAPES["arr"])
+    _sharded_diff(SHAPES["arr"], random_datums(entry.ir, 1, seed=43), 8)
+
+
+def test_sharded_cap_retry():
+    # item counts past the optimistic cap exercise the shared growth path
+    schema = SHAPES["arr"]
+    entry = get_or_parse_schema(schema)
+    from pyruhvro_tpu.fallback.encoder import compile_writer
+
+    w = compile_writer(entry.ir)
+    rows = [
+        {"xs": [f"v{i}-{j}" for j in range(29)], "ys": [i, -i],
+         "na": (0, None)}
+        for i in range(19)
+    ]
+    datums = []
+    for r in rows:
+        buf = bytearray()
+        w(buf, r)
+        datums.append(bytes(buf))
+    _sharded_diff(schema, datums, 4)
+
+
+def test_sharded_malformed_reports_global_row():
+    entry = get_or_parse_schema(SHAPES["flat"])
+    datums = random_datums(entry.ir, 40, seed=47)
+    datums[33] = datums[33] + b"\x00"  # trailing bytes in chunk 6 of 8
+    sharded = ShardedDecoder(entry.ir, mesh=chunk_mesh(n_devices=8))
+    with pytest.raises(MalformedAvro, match="record 33"):
+        sharded.decode(datums, entry.ir, entry.arrow_schema)
+
+
+def test_api_threaded_uses_mesh_and_matches_host():
+    # public API: chunk count == device count → one sharded launch,
+    # chunk boundaries exactly the reference's slicing
+    datums = kafka_style_datums(120, seed=53)
+    dev = pv.deserialize_array_threaded(
+        datums, KAFKA_SCHEMA_JSON, 8, backend="tpu"
+    )
+    host = pv.deserialize_array_threaded(
+        datums, KAFKA_SCHEMA_JSON, 8, backend="host"
+    )
+    assert len(dev) == len(host) == 8
+    for d, h in zip(dev, host):
+        assert d.equals(h)
+
+
+@pytest.mark.parametrize("num_chunks", [3, 5, 16])
+def test_api_threaded_chunk_count_mismatch(num_chunks):
+    # chunk counts that don't match the mesh still honor reference
+    # slicing (decode sharded, then re-slice)
+    datums = kafka_style_datums(77, seed=59)
+    dev = pv.deserialize_array_threaded(
+        datums, KAFKA_SCHEMA_JSON, num_chunks, backend="tpu"
+    )
+    host = pv.deserialize_array_threaded(
+        datums, KAFKA_SCHEMA_JSON, num_chunks, backend="host"
+    )
+    assert len(dev) == len(host)
+    for d, h in zip(dev, host):
+        assert d.num_rows == h.num_rows
+        assert d.equals(h)
+
+
+def test_dryrun_multichip_entry():
+    import importlib.util
+    import pathlib
+
+    root = pathlib.Path(__file__).resolve().parents[1]
+    spec = importlib.util.spec_from_file_location(
+        "graft_entry", root / "__graft_entry__.py"
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    mod.dryrun_multichip(8)
+
+    fn, args = mod.entry()
+    out = jax.jit(fn)(*args)
+    assert out.dtype.name == "uint8" and out.ndim == 1
