@@ -52,12 +52,14 @@ def _log(msg: str) -> None:
 # backend bring-up (eager, loud, time-bounded)
 # ---------------------------------------------------------------------------
 
-def init_backend(timeout_s: float, retries: int = 1):
+def init_backend(timeout_s: float):
     """Initialize the JAX backend before any timing.
 
     Returns ``(devices, platform, seconds)`` or ``(None, reason, seconds)``.
     Distinguishes slow-init (heartbeats, then success) from a wedged
-    transport (timeout after ``timeout_s`` despite ``retries``)."""
+    transport (no return within ``timeout_s``). No retry: a second
+    ``jax.devices()`` call would just block on the same backend-init
+    lock the wedged thread holds."""
     import threading
 
     _log("[bench] backend env: JAX_PLATFORMS=%r PYTHONPATH=%r" % (
@@ -69,42 +71,39 @@ def init_backend(timeout_s: float, retries: int = 1):
 
     _log(f"[bench] jax {jax.__version__} imported in "
          f"{time.perf_counter() - t0:.1f}s; initializing backend "
-         f"(timeout {timeout_s:.0f}s per attempt, {retries + 1} attempts)")
+         f"(timeout {timeout_s:.0f}s)")
 
-    for attempt in range(retries + 1):
-        box: list = []
-        t1 = time.perf_counter()
+    box: list = []
+    t1 = time.perf_counter()
 
-        def run():
-            try:
-                box.append(jax.devices())
-            except BaseException as e:  # noqa: BLE001 — reported below
-                box.append(e)
+    def run():
+        try:
+            box.append(jax.devices())
+        except BaseException as e:  # noqa: BLE001 — reported below
+            box.append(e)
 
-        th = threading.Thread(target=run, daemon=True)
-        th.start()
-        beat = 30.0
-        while th.is_alive():
-            th.join(min(beat, 30.0))
-            el = time.perf_counter() - t1
-            if th.is_alive():
-                _log(f"[bench] backend init attempt {attempt + 1} still "
-                     f"running after {el:.0f}s ...")
-                if el >= timeout_s:
-                    break
+    th = threading.Thread(target=run, daemon=True)
+    th.start()
+    while th.is_alive():
         el = time.perf_counter() - t1
-        if box:
-            out = box[0]
-            if isinstance(out, BaseException):
-                _log(f"[bench] backend init FAILED in {el:.1f}s: {out!r}")
-                return None, f"init error: {out!r}", el
-            plat = out[0].platform if out else "none"
-            _log(f"[bench] backend ready in {el:.1f}s: {out} "
-                 f"(platform={plat})")
-            return out, plat, el
-        _log(f"[bench] backend init attempt {attempt + 1} TIMED OUT "
-             f"after {el:.0f}s (wedged device transport?)"
-             + ("; retrying" if attempt < retries else ""))
+        remaining = timeout_s - el
+        if remaining <= 0:
+            break
+        th.join(min(30.0, remaining))
+        el = time.perf_counter() - t1
+        if th.is_alive() and el < timeout_s:
+            _log(f"[bench] backend init still running after {el:.0f}s ...")
+    el = time.perf_counter() - t1
+    if box:
+        out = box[0]
+        if isinstance(out, BaseException):
+            _log(f"[bench] backend init FAILED in {el:.1f}s: {out!r}")
+            return None, f"init error: {out!r}", el
+        plat = out[0].platform if out else "none"
+        _log(f"[bench] backend ready in {el:.1f}s: {out} "
+             f"(platform={plat})")
+        return out, plat, el
+    _log(f"[bench] backend init TIMED OUT after {el:.0f}s")
     _log("[bench] ============================================================")
     _log("[bench] DEVICE TRANSPORT WEDGED: jax.devices() never returned.")
     _log("[bench] This is an environment/tunnel failure, not a codec error —")

@@ -16,7 +16,8 @@ __all__ = ["UnsupportedOnDevice"]
 
 
 class UnsupportedOnDevice(ValueError):
-    """Schema is valid but outside the *device* kernel's subset (e.g. an
-    array nested inside another array/map's items). ``backend='auto'``
-    falls back to the host path silently, matching the reference's
-    unsupported-schema gate (``deserialize.rs:26-29``)."""
+    """Schema is valid but outside the *device* kernel's subset (the
+    fast-path subset: bytes/fixed/decimal/uuid/duration/time-* are
+    host-only). ``backend='auto'`` falls back to the host path silently,
+    matching the reference's unsupported-schema gate
+    (``deserialize.rs:26-29``)."""

@@ -179,6 +179,7 @@ class DeviceDecoder:
         ]
         # per-region item-total caps, remembered per R bucket
         self._tot_cap_mem: Dict[Tuple[int, int], int] = {}
+        self._seed_tried: set = set()  # (R, rid) sampling attempts
         self._lock = threading.Lock()
 
     # -- traced pieces -----------------------------------------------------
@@ -190,7 +191,12 @@ class DeviceDecoder:
         from .varint import ERR_TRAILING
 
         def cap_of(region: int) -> int:
-            return R if region == ROWS else R * item_caps[region]
+            # strided slot space: product of item caps down the ancestry
+            cap = R
+            while region != ROWS:
+                cap *= item_caps[region]
+                region = prog.region_parents[region]
+            return cap
 
         row = jnp.arange(R, dtype=jnp.int32)
         st = {"#cursor": starts, "#err": jnp.zeros(R, jnp.uint32)}
@@ -248,26 +254,45 @@ class DeviceDecoder:
         def pipeline(words, starts, lengths, n):
             st = self._trace_walk(R, item_caps, words, starts, lengths, n)
             out = {}
+            # compaction cascades parent-first (region ids are in DFS
+            # order): a nested region's counts live in its parent's
+            # STRIDED slot space and are first gathered through the
+            # parent's compaction map
+            slot_maps = {}  # rid -> (strided slot per compact idx, in_range)
             for rid in range(1, len(prog.regions)):
                 path = prog.regions[rid]
+                parent = prog.region_parents[rid]
                 icap, tcap = item_caps[rid], tot_caps[rid]
-                counts = st[path + "#count"]
+                counts_raw = st[path + "#count"]
+                if parent == ROWS:
+                    n_entries = R
+                    counts_c = counts_raw
+                    parent_slot = jnp.arange(R, dtype=jnp.int32)
+                else:
+                    parent_slot, parent_in = slot_maps[parent]
+                    n_entries = tot_caps[parent]
+                    taken = jnp.take(counts_raw, parent_slot, mode="clip")
+                    counts_c = jnp.where(parent_in, taken, 0)
                 offsets = jnp.concatenate(
                     [jnp.zeros(1, jnp.int32),
-                     jnp.cumsum(counts, dtype=jnp.int32)]
+                     jnp.cumsum(counts_c, dtype=jnp.int32)]
                 )
                 out[path + "#offsets"] = offsets
                 j = jnp.arange(tcap, dtype=jnp.int32)
-                row = row_of(offsets, R, tcap)
-                slot = row * icap + (j - jnp.take(offsets, row, mode="clip"))
+                ent = row_of(offsets, n_entries, tcap)
+                slot = (
+                    jnp.take(parent_slot, ent, mode="clip") * icap
+                    + (j - jnp.take(offsets, ent, mode="clip"))
+                )
                 # entries past the region's true total are zeroed — their
                 # lens feed host-side cumsums
                 in_range = j < offsets[-1]
+                slot_maps[rid] = (slot, in_range)
                 for spec in item_buffers[rid]:
                     taken = jnp.take(st[spec.key], slot, mode="clip")
                     out[spec.key] = jnp.where(in_range, taken,
                                               jnp.zeros_like(taken))
-                out["#red:max:" + path] = jnp.max(counts).reshape(1)
+                out["#red:max:" + path] = jnp.max(counts_c).reshape(1)
                 out["#red:sum:" + path] = offsets[-1].reshape(1)
             for spec in prog.buffers.values():
                 if spec.region == ROWS and spec.key.rpartition("#")[2] != "count":
@@ -293,7 +318,9 @@ class DeviceDecoder:
         sizes: Dict[str, tuple] = {}
         for rid in range(1, len(prog.regions)):
             path = prog.regions[rid]
-            sizes[path + "#offsets"] = (np.int32, R + 1)
+            parent = prog.region_parents[rid]
+            n_entries = R if parent == ROWS else tot_caps[parent]
+            sizes[path + "#offsets"] = (np.int32, n_entries + 1)
             for spec in item_buffers[rid]:
                 sizes[spec.key] = (np.dtype(spec.dtype), tot_caps[rid])
             sizes["#red:max:" + path] = (np.int32, 1)
@@ -352,7 +379,12 @@ class DeviceDecoder:
                 rid
                 for rid in range(1, len(prog.regions))
                 if (R, rid) not in self._tot_cap_mem
+                and (R, rid) not in self._seed_tried
             ]
+            # one sampling attempt per (R, region) — a region the sample
+            # can't resolve (e.g. nested repetition) must not re-pay the
+            # host scan on every steady-state decode
+            self._seed_tried.update((R, rid) for rid in need)
         if not need:
             return
         k = min(len(data), 128)
@@ -384,20 +416,25 @@ class DeviceDecoder:
                 )
 
     def caps_snapshot(self, R: int):
-        """Atomic snapshot of ``(item_caps, tot_caps)`` for an R bucket."""
+        """Atomic snapshot of ``(item_caps, tot_caps)`` for an R bucket.
+
+        A region's item total is bounded by (parent's entry total ×
+        items/entry cap); parents precede children in region order, so
+        one forward sweep resolves the nested bounds."""
         prog = self.prog
         with self._lock:
             item_caps = tuple(self._item_caps)
-            tot_caps = tuple(
-                [0]
-                + [
+            tot_caps = [0]
+            for rid in range(1, len(prog.regions)):
+                parent = prog.region_parents[rid]
+                parent_total = R if parent == ROWS else tot_caps[parent]
+                tot_caps.append(
                     min(
                         self._tot_cap_mem.get((R, rid), _DEFAULT_TOT_CAP),
-                        R * item_caps[rid],
+                        parent_total * item_caps[rid],
                     )
-                    for rid in range(1, len(prog.regions))
-                ]
-            )
+                )
+            tot_caps = tuple(tot_caps)
         return item_caps, tot_caps
 
     def grow_caps(self, R, item_caps, tot_caps, red_max, red_sum) -> bool:

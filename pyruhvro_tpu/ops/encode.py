@@ -178,12 +178,7 @@ class _EncLowering:
                 return self.lower_nullable(t, path, region)
             return self.lower_union(t, path, region)
         if isinstance(t, (Array, Map)):
-            if region != ROWS:
-                raise UnsupportedOnDevice(
-                    f"nested repetition at {path!r} (array/map inside "
-                    f"array/map items) is outside the device subset"
-                )
-            return self.lower_repeated(t, path)
+            return self.lower_repeated(t, path, region)
         raise UnsupportedOnDevice(f"type {type(t).__name__} at {path!r}")
 
     # -- leaves -----------------------------------------------------------
@@ -376,12 +371,16 @@ class _EncLowering:
 
         return size, write
 
-    def lower_repeated(self, t, path: str):
+    def lower_repeated(self, t, path: str, region: int = ROWS):
         """Array/map single-block form ``[count, items..., 0]`` / ``0``.
 
         Item positions come from one within-row prefix sum over the flat
         item axis — the TPU replacement for the reference's per-item
-        sequential writes (``fast_encode.rs:518-554``)."""
+        sequential writes (``fast_encode.rs:518-554``). Nesting composes
+        for free: an inner repeated field's counts live on the OUTER
+        item axis (``region``), and its flat item axis is the Arrow
+        grandchild — the same prefix-sum machinery, one level down
+        (≙ recursive encoders, ``fast_encode.rs:518-554``)."""
         rid = len(self.regions)
         self.regions.append(path)
         if isinstance(t, Array):
@@ -632,7 +631,7 @@ class _Extractor:
                                  sub)
             return
         if isinstance(t, (Array, Map)):
-            self._extract_repeated(t, arr, path, parent)
+            self._extract_repeated(t, arr, path, region, parent)
             return
         raise UnsupportedOnDevice(f"type {type(t).__name__} at {path!r}")
 
@@ -724,7 +723,7 @@ class _Extractor:
         )
         self.bound += 5 * len(arr)
 
-    def _extract_repeated(self, t, arr, path,
+    def _extract_repeated(self, t, arr, path, region: int,
                           parent: Optional[np.ndarray]) -> None:
         rid = len(self.regions)
         self.regions.append(path)
@@ -736,10 +735,11 @@ class _Extractor:
         # RAW counts: the device derives the flat item-axis mapping from
         # cumsum(counts), which must mirror the Arrow child layout even
         # at rows the walk later masks out (a null row may still own a
-        # nonzero offset range)
+        # nonzero offset range). For nested repetition the counts live on
+        # the OUTER item axis (``region``).
         counts = np.diff(offs).astype(np.int32)
         base, end = int(offs[0]), int(offs[-1])
-        self.put(path + "#count", counts, ROWS)
+        self.put(path + "#count", counts, region)
         self.region_len[rid] = end - base
         self.bound += 7 * n  # count varint (≤5) + terminator + slack
         # lift the row validity chain onto the item axis

@@ -24,11 +24,11 @@ Output layout (the "column specs"):
   all: the walk records ``(start, len)`` only, and the finalize pass
   (``ops/decode.py``) gathers value bytes once sizes are known.
 
-Device subset = the reference's fast subset (``fast_decode.rs:38-61``)
-minus nested repetition (an array/map anywhere inside another array/map's
-items raises :class:`UnsupportedOnDevice` → silent host fallback in
-``backend='auto'``, the same degradation the reference applies to
-unsupported schemas).
+Device subset = the reference's fast subset (``fast_decode.rs:38-61``),
+including nested repetition: an array/map inside another array/map's
+items becomes a child *region* whose strided slots are indexed by the
+parent item's slot (≙ the recursive ``ListDecoder``/``MapDecoder``,
+``fast_decode.rs:125-167,689-786``).
 """
 
 from __future__ import annotations
@@ -97,6 +97,7 @@ class Program:
     ir: Record
     buffers: Dict[str, BufSpec]
     regions: List[str]          # region id → path of the repeated field ("" = rows)
+    region_parents: List[int]   # region id → parent region (-1 for rows)
     string_cols: List[StringCol]
     emit: Callable              # emit(cx, st, mask, out_idx) -> st  (top record)
 
@@ -144,6 +145,7 @@ class _Lowering:
     def __init__(self) -> None:
         self.buffers: Dict[str, BufSpec] = {}
         self.regions: List[str] = [""]
+        self.region_parents: List[int] = [-1]
         self.string_cols: List[StringCol] = []
 
     def buf(self, key: str, dtype, region: int) -> None:
@@ -165,12 +167,7 @@ class _Lowering:
                 return self.lower_nullable(t, path, region)
             return self.lower_union(t, path, region)
         if isinstance(t, (Array, Map)):
-            if region != ROWS:
-                raise UnsupportedOnDevice(
-                    f"nested repetition at {path!r} (array/map inside "
-                    f"array/map items) is outside the device subset"
-                )
-            return self.lower_repeated(t, path)
+            return self.lower_repeated(t, path, region)
         raise UnsupportedOnDevice(f"type {type(t).__name__} at {path!r}")
 
     def lower_primitive(self, t: Primitive, path: str, region: int) -> Callable:
@@ -340,15 +337,23 @@ class _Lowering:
 
         return emit_union
 
-    def lower_repeated(self, t, path: str) -> Callable:
+    def lower_repeated(self, t, path: str, region: int = ROWS) -> Callable:
         """Array/map block protocol as one vectorized ``lax.while_loop``:
         each iteration reads pending block headers and decodes at most one
-        item per active lane into strided slots ``row * item_cap + i``.
-        Negative block counts (item-count with byte-size prefix,
-        ``fast_decode.rs:689-700``) consume and discard the size."""
+        item per active lane into strided slots ``parent_slot * item_cap
+        + i``. Negative block counts (item-count with byte-size prefix,
+        ``fast_decode.rs:689-700``) consume and discard the size.
+
+        Nested repetition (``region != ROWS``, ≙ the reference's
+        recursive ``ListDecoder``/``MapDecoder``,
+        ``fast_decode.rs:125-167,689-786``) composes naturally: the
+        inner repeated emitter runs its own while_loop inside the outer
+        body, indexed by the outer item's strided slot; the finalize
+        pass (``ops/decode.py``) cascades the compaction parent-first."""
         rid = len(self.regions)
         self.regions.append(path)
-        self.buf(path + "#count", I32, ROWS)
+        self.region_parents.append(region)
+        self.buf(path + "#count", I32, region)
         if isinstance(t, Array):
             item_emitters = [self.lower_type(t.items, path + "/@item", rid)]
         else:  # Map: key string + value
@@ -359,16 +364,21 @@ class _Lowering:
                 self.lower_type(t.values, path + "/@val", rid),
             ]
 
-        # only the buffers the loop writes travel in the while carry; the
-        # rest of the (large) state dict stays outside — this keeps the XLA
-        # loop body small, which dominates compile time
+        # only the buffers the loop writes travel in the while carry: this
+        # region's, plus any nested region's (their loops run inside this
+        # body); the rest of the (large) state dict stays outside — this
+        # keeps the XLA loop body small, which dominates compile time
         loop_keys = None
 
         def emit_repeated(cx, st, mask, out_idx):
             nonlocal loop_keys
             if loop_keys is None:
+                rids = {rid}
+                for r in range(rid + 1, len(self.regions)):
+                    if self.region_parents[r] in rids:
+                        rids.add(r)
                 loop_keys = sorted(
-                    k for k, s in self.buffers.items() if s.region == rid
+                    k for k, s in self.buffers.items() if s.region in rids
                 ) + ["#cursor", "#err"]
             icap = cx.item_caps[rid]
             base = (
@@ -459,6 +469,7 @@ def lower(ir: AvroType) -> Program:
         ir=ir,
         buffers=lo.buffers,
         regions=lo.regions,
+        region_parents=lo.region_parents,
         string_cols=lo.string_cols,
         emit=emit,
     )

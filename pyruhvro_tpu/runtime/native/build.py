@@ -58,7 +58,24 @@ def load_native():
         so = _so_path()
         try:
             if _needs_build(so):
-                _compile(so)
+                try:
+                    _compile(so)
+                except Exception as e:
+                    # a wheel-built .so in a read-only site-packages can
+                    # trip the mtime check (install order) yet be
+                    # perfectly usable — prefer loading it over nothing,
+                    # but never silently: a dev editing packer.cpp must
+                    # see that the stale binary is still in use
+                    if not os.path.exists(so):
+                        raise
+                    import warnings
+
+                    warnings.warn(
+                        f"pyruhvro_tpu: rebuilding the native packer "
+                        f"failed ({e!r}); using the existing (possibly "
+                        f"stale) {os.path.basename(so)}",
+                        RuntimeWarning,
+                    )
             spec = importlib.util.spec_from_file_location("_pyruhvro_native", so)
             mod = importlib.util.module_from_spec(spec)
             spec.loader.exec_module(mod)

@@ -1,0 +1,123 @@
+#!/usr/bin/env python
+"""Standalone decode profiling loop (≙ ``ruhvro/examples/prof_decode.rs``).
+
+The reference profiles with samply/flamegraph over a hot loop
+(1k records × many iters of the array_and_map schema, 8 chunks); the
+JAX-native equivalent is a ``jax.profiler`` trace (open in TensorBoard
+or Perfetto) plus the library's own phase counters
+(``pyruhvro_tpu.metrics``), which split wall time into pack / h2d /
+compile / launch / d2h — the split that matters on a high-latency
+interconnect.
+
+Usage::
+
+    python scripts/profile_decode.py --rows 1000 --iters 50
+    python scripts/profile_decode.py --op serialize --trace-dir /tmp/tr
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--rows", type=int, default=1_000)
+    ap.add_argument("--iters", type=int, default=50)
+    ap.add_argument("--chunks", type=int, default=8)
+    ap.add_argument("--op", choices=("deserialize", "serialize"),
+                    default="deserialize")
+    ap.add_argument("--schema", default="array_and_map",
+                    help="kafka or a CRITERION_SHAPES name")
+    ap.add_argument("--backend", default="tpu",
+                    choices=("tpu", "host", "auto"))
+    ap.add_argument("--trace-dir", default=None,
+                    help="write a jax.profiler trace here (TensorBoard/"
+                         "Perfetto); omit to profile counters only")
+    args = ap.parse_args()
+
+    from pyruhvro_tpu import (
+        deserialize_array,
+        deserialize_array_threaded,
+        metrics,
+        serialize_record_batch,
+    )
+    from pyruhvro_tpu.utils.datagen import (
+        CRITERION_SHAPES,
+        KAFKA_SCHEMA_JSON,
+        kafka_style_datums,
+        random_datums,
+    )
+
+    if args.schema == "kafka":
+        schema = KAFKA_SCHEMA_JSON
+        datums = kafka_style_datums(args.rows, seed=5)
+    else:
+        schema = CRITERION_SHAPES[args.schema]
+        from pyruhvro_tpu.schema.cache import get_or_parse_schema
+
+        datums = random_datums(
+            get_or_parse_schema(schema).ir, args.rows, seed=5
+        )
+
+    if args.op == "deserialize":
+        def step():
+            return deserialize_array_threaded(
+                datums, schema, args.chunks, backend=args.backend
+            )
+    else:
+        batch = deserialize_array(datums, schema, backend="host")
+
+        def step():
+            return serialize_record_batch(
+                batch, schema, args.chunks, backend=args.backend
+            )
+
+    print(f"warmup (compiles)...", file=sys.stderr, flush=True)
+    step()
+    metrics.reset()
+
+    tracer = None
+    if args.trace_dir:
+        import jax
+
+        tracer = jax.profiler.trace(args.trace_dir)
+        tracer.__enter__()
+
+    t0 = time.perf_counter()
+    for _ in range(args.iters):
+        step()
+    wall = time.perf_counter() - t0
+
+    if tracer is not None:
+        tracer.__exit__(None, None, None)
+        print(f"trace written to {args.trace_dir}", file=sys.stderr)
+
+    snap = metrics.snapshot()
+    rec_s = args.rows * args.iters / wall
+    phases = {
+        k: round(v, 6) for k, v in sorted(snap.items())
+    }
+    per_iter_ms = {
+        k.split(".", 1)[1][:-2]: round(v / args.iters * 1e3, 3)
+        for k, v in sorted(snap.items())
+        if k.endswith("_s")
+    }
+    print(json.dumps({
+        "op": args.op, "schema": args.schema, "backend": args.backend,
+        "rows": args.rows, "iters": args.iters,
+        "wall_s": round(wall, 4),
+        "records_per_s": round(rec_s, 1),
+        "per_iter_ms": per_iter_ms,
+        "counters": phases,
+    }, indent=2))
+
+
+if __name__ == "__main__":
+    main()

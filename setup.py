@@ -1,0 +1,23 @@
+"""Build shim: compile the C++ host packer at wheel-build time.
+
+≙ the reference's maturin build of its PyO3 extension
+(``/root/reference/pyproject.toml:1-3``). The extension is ``optional``:
+if no C++ toolchain is present the wheel still builds, and the package
+falls back first to the import-time JIT build
+(``pyruhvro_tpu/runtime/native/build.py``), then to the vectorized numpy
+packer.
+"""
+
+from setuptools import Extension, setup
+
+setup(
+    ext_modules=[
+        Extension(
+            "pyruhvro_tpu.runtime.native._pyruhvro_native",
+            sources=["pyruhvro_tpu/runtime/native/packer.cpp"],
+            language="c++",
+            extra_compile_args=["-O3", "-std=c++17", "-pthread"],
+            optional=True,
+        )
+    ],
+)

@@ -2,7 +2,12 @@
 
 Multi-chip configs are tested on CPU via device-count spoofing
 (SURVEY.md §4.7): real-TPU behavior is exercised by the driver's bench
-run, not by unit tests. Must run before the first `import jax` anywhere.
+run and the opt-in ``-m device`` smoke tests, not by the unit suite.
+Must run before the first `import jax` anywhere.
+
+Opt-in real-backend mode: ``PYRUHVRO_DEVICE_TEST=1 pytest -m device``
+leaves the platform config alone so ``tests/test_device_smoke.py``
+reaches the actual accelerator.
 
 Device-tunnel site hooks (e.g. axon) hijack JAX backend resolution for
 the whole process — even in CPU mode a wedged tunnel would hang the
@@ -15,34 +20,37 @@ config pinned back to cpu.
 import os
 import sys
 
-os.environ["JAX_PLATFORMS"] = "cpu"
-_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (
-        _flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
+DEVICE_MODE = os.environ.get("PYRUHVRO_DEVICE_TEST") == "1"
 
-# keep subprocesses (if any) clean too
-os.environ["PYTHONPATH"] = os.pathsep.join(
-    p
-    for p in os.environ.get("PYTHONPATH", "").split(os.pathsep)
-    if p and ".axon_site" not in p
-)
-sys.path[:] = [p for p in sys.path if ".axon_site" not in p]
+if not DEVICE_MODE:
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    _flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in _flags:
+        os.environ["XLA_FLAGS"] = (
+            _flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
 
-if any("axon" in name for name in list(sys.modules)):
-    # the tunnel hook is already installed: unwind it and re-pin cpu
-    import jax
-    from jax._src import xla_bridge as _xb
+    # keep subprocesses (if any) clean too
+    os.environ["PYTHONPATH"] = os.pathsep.join(
+        p
+        for p in os.environ.get("PYTHONPATH", "").split(os.pathsep)
+        if p and ".axon_site" not in p
+    )
+    sys.path[:] = [p for p in sys.path if ".axon_site" not in p]
 
-    hook = _xb._get_backend_uncached
-    if getattr(hook, "__name__", "") == "_axon_get_backend_uncached":
-        for cell in hook.__closure__ or ():
-            try:
-                v = cell.cell_contents
-            except ValueError:
-                continue
-            if callable(v):
-                _xb._get_backend_uncached = v
-                break
-    jax.config.update("jax_platforms", "cpu")
+    if any("axon" in name for name in list(sys.modules)):
+        # the tunnel hook is already installed: unwind it and re-pin cpu
+        import jax
+        from jax._src import xla_bridge as _xb
+
+        hook = _xb._get_backend_uncached
+        if getattr(hook, "__name__", "") == "_axon_get_backend_uncached":
+            for cell in hook.__closure__ or ():
+                try:
+                    v = cell.cell_contents
+                except ValueError:
+                    continue
+                if callable(v):
+                    _xb._get_backend_uncached = v
+                    break
+        jax.config.update("jax_platforms", "cpu")

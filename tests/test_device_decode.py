@@ -60,6 +60,20 @@ SHAPES = {
         {"name":"rs","type":{"type":"array","items":{"type":"record","name":"P",
             "fields":[{"name":"k","type":"string"},
                       {"name":"v","type":["null","long"]}]}}}]}""",
+    # nested repetition (≙ recursive ListDecoder/MapDecoder,
+    # fast_decode.rs:125-167,689-786)
+    "arr_arr": """{"type":"record","name":"AA","fields":[
+        {"name":"aa","type":{"type":"array","items":
+            {"type":"array","items":"int"}}},
+        {"name":"ms","type":{"type":"map","values":
+            {"type":"array","items":"string"}}}]}""",
+    "arr_rec_arr": """{"type":"record","name":"ARA","fields":[
+        {"name":"rs","type":{"type":"array","items":{"type":"record",
+            "name":"Q","fields":[
+                {"name":"name","type":"string"},
+                {"name":"vals","type":{"type":"array","items":"long"}},
+                {"name":"nm","type":["null",{"type":"map",
+                    "values":"double"}]}]}}}]}""",
 }
 
 
@@ -142,19 +156,30 @@ def test_device_trailing_bytes_raise():
         get_device_codec(entry).decode([good + b"\x00"])
 
 
-def test_nested_repetition_unsupported_on_device():
+def test_nested_repetition_deep():
+    # three levels: array<array<array<int>>> — regions chain rows→r1→r2→r3
     schema = json.dumps({
-        "type": "record", "name": "NR",
-        "fields": [{"name": "aa", "type": {
-            "type": "array",
-            "items": {"type": "array", "items": "int"}}}],
+        "type": "record", "name": "NR3",
+        "fields": [{"name": "aaa", "type": {
+            "type": "array", "items": {
+                "type": "array",
+                "items": {"type": "array", "items": "int"}}}}],
+    })
+    entry = get_or_parse_schema(schema)
+    _diff(schema, random_datums(entry.ir, 31, seed=101))
+
+
+def test_out_of_subset_schema_unsupported_on_device():
+    # bytes stays host-only; the public API silently serves it
+    schema = json.dumps({
+        "type": "record", "name": "B",
+        "fields": [{"name": "b", "type": "bytes"}],
     })
     entry = get_or_parse_schema(schema)
     with pytest.raises(UnsupportedOnDevice):
         from pyruhvro_tpu.ops.fieldprog import lower
 
         lower(entry.ir)
-    # ... but the public API silently serves it from the host path
     datums = random_datums(entry.ir, 7, seed=3)
     batch = pv.deserialize_array(datums, schema, backend="auto")
     assert batch.num_rows == 7

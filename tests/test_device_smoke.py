@@ -1,0 +1,64 @@
+"""Opt-in smoke tests against the REAL accelerator backend.
+
+Run with::
+
+    PYRUHVRO_DEVICE_TEST=1 python -m pytest tests -m device
+
+The default suite excludes these (``pyproject.toml`` addopts) and pins
+JAX to a spoofed CPU mesh; this file is the one place a real transport
+regression (e.g. a wedged axon tunnel — VERDICT r02's init hang) shows
+up in the builder loop instead of the driver's bench. The backend probe
+is time-bounded by ``PYRUHVRO_TPU_PROBE_TIMEOUT`` (default 60 s), so a
+dead transport FAILS loudly here rather than hanging.
+"""
+
+import os
+
+import pytest
+
+pytestmark = [
+    pytest.mark.device,
+    pytest.mark.skipif(
+        os.environ.get("PYRUHVRO_DEVICE_TEST") != "1",
+        reason="set PYRUHVRO_DEVICE_TEST=1 to run real-backend smoke tests",
+    ),
+]
+
+
+def test_real_backend_decode_smoke():
+    import pyruhvro_tpu as pv
+    from pyruhvro_tpu.utils.datagen import (
+        KAFKA_SCHEMA_JSON,
+        kafka_style_datums,
+    )
+
+    datums = kafka_style_datums(256, seed=1)
+    # backend='tpu' raises (bounded by the probe timeout) if the device
+    # transport is down — that failure IS the signal this test exists for
+    batch = pv.deserialize_array(datums, KAFKA_SCHEMA_JSON, backend="tpu")
+    host = pv.deserialize_array(datums, KAFKA_SCHEMA_JSON, backend="host")
+    assert batch.num_rows == 256
+    assert batch.equals(host)
+
+
+def test_real_backend_encode_smoke():
+    import pyruhvro_tpu as pv
+    from pyruhvro_tpu.utils.datagen import (
+        KAFKA_SCHEMA_JSON,
+        kafka_style_datums,
+    )
+
+    datums = kafka_style_datums(128, seed=2)
+    batch = pv.deserialize_array(datums, KAFKA_SCHEMA_JSON, backend="host")
+    out = pv.serialize_record_batch(batch, KAFKA_SCHEMA_JSON, 1,
+                                    backend="tpu")
+    assert [bytes(x) for x in out[0].to_pylist()] == list(datums)
+
+
+def test_real_backend_platform_is_accelerator():
+    import jax
+
+    plat = jax.devices()[0].platform
+    if plat == "cpu":
+        pytest.skip("no accelerator attached (CPU-only environment)")
+    assert plat  # e.g. 'tpu' / 'axon'
