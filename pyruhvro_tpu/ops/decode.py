@@ -113,7 +113,8 @@ def pad_views(flat: np.ndarray, offsets: np.ndarray, n: int, R: int, B: int):
 _DEFAULT_ITEM_CAP = 8
 _DEFAULT_TOT_CAP = 8
 # per-record item-slot ceiling: beyond this the strided buffers would not
-# fit device memory; the codec falls back to the host path for the batch
+# fit device memory; ``grow_caps`` raises DeviceCapacityExceeded and the
+# codec serves that batch from the host path (codec.py catches it)
 _MAX_ITEM_CAP = 1 << 20
 _cache_enabled = False
 

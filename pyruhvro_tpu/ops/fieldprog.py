@@ -459,7 +459,8 @@ def lower(ir: AvroType) -> Program:
 
     Raises :class:`UnsupportedOnDevice` when outside the device subset
     (which is the reference's fast subset, ``fast_decode.rs:38-61``,
-    minus nested repetition).
+    nested repetition included — ``lower_repeated`` recurses, with the
+    inner region's strided slots indexed by the outer item's slot).
     """
     if not is_supported(ir):
         raise UnsupportedOnDevice("schema is outside the fast-path subset")
