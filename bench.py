@@ -217,8 +217,16 @@ def main() -> None:
                     help="large-batch scaling row count (0 = skip)")
     ap.add_argument("--reps", type=int, default=5)
     ap.add_argument("--chunks", type=int, default=8)
-    ap.add_argument("--host-cap", type=int, default=20_000,
-                    help="skip host timing above this row count")
+    ap.add_argument("--host-cap", type=int, default=20_000_000,
+                    help="skip host timing above this row count (the host "
+                         "path is the native C++ VM since r04 — fast at "
+                         "every size; the cap now only guards pathological "
+                         "row counts)")
+    ap.add_argument("--north-star", type=int,
+                    default=int(os.environ.get("BENCH_NORTH_STAR",
+                                               10_000_000)),
+                    help="north-star row count (BASELINE.md: 10M rows; "
+                         "0 = skip)")
     ap.add_argument("--probe-timeout", type=float,
                     default=float(os.environ.get(
                         "PYRUHVRO_TPU_PROBE_TIMEOUT", 900)))
@@ -282,13 +290,42 @@ def main() -> None:
         _run_case("serialize", kafka, datums, backend, args.chunks,
                   args.reps, details)
 
-    # large-batch scaling point (device only; host is O(minutes) there)
-    if use_device and args.big_rows:
+    # large-batch scaling point
+    if args.big_rows:
         big = _gen_kafka(args.big_rows)
-        rec_s = _run_case("deserialize", kafka, big, "tpu", args.chunks,
-                          max(2, args.reps - 2), details, label="big/")
-        if rec_s and (headline is None or rec_s > headline[0]):
-            headline = (rec_s, dev_name, args.big_rows)
+        for backend in backends:
+            if backend == "host" and args.big_rows > args.host_cap:
+                continue
+            rec_s = _run_case("deserialize", kafka, big, backend,
+                              args.chunks, max(2, args.reps - 2), details,
+                              label="big/")
+            name = dev_name if backend == "tpu" else "host"
+            if rec_s and (headline is None or rec_s > headline[0]):
+                headline = (rec_s, name, args.big_rows)
+        del big
+
+    # north-star config (BASELINE.md): 10M rows, single chip/host.
+    # The native host VM serves it; without the VM (no toolchain /
+    # disabled) the pure-Python fallback would take hours, so the phase
+    # is gated on native availability AND the host cap.
+    def _native_ok():
+        try:
+            from pyruhvro_tpu.hostpath import native_available
+
+            return native_available()
+        except Exception:
+            return False
+
+    if (args.north_star and args.north_star > args.big_rows
+            and args.north_star <= args.host_cap and _native_ok()):
+        ns = _gen_kafka(args.north_star)
+        for op in ("deserialize", "serialize"):
+            rec_s = _run_case(op, kafka, ns, "host", args.chunks, 2,
+                              details, label="northstar/")
+            if (op == "deserialize" and rec_s
+                    and (headline is None or rec_s > headline[0])):
+                headline = (rec_s, "host", args.north_star)
+        del ns
 
     save_details()
     if headline is None:

@@ -13,10 +13,20 @@
 * ``serialize_record_batch_spawn`` — ditto.
 
 One addition over the reference (the BASELINE.json north star):
-``backend=`` on every function — ``"auto"`` (default; TPU when the schema
-is in the fast subset and a device is present, matching the silent
-fast/fallback gate at ``deserialize.rs:26-29``), ``"tpu"`` (force device;
-errors if unsupported), ``"host"`` (force the general path).
+``backend=`` on every function — ``"auto"`` (default), ``"tpu"`` (force
+device; errors if unsupported), ``"host"`` (force the host path).
+
+The host path itself is two-tiered, mirroring the reference's
+fast/fallback split (``deserialize.rs:26-29``): schemas in the fast
+subset decode through the **native C++ VM** (:mod:`.hostpath`, built on
+demand); everything else through the pure-Python fallback decoder (the
+differential oracle). ``backend="auto"`` picks device vs host by a
+one-time interconnect probe: on a co-located accelerator the device
+path wins from small batch sizes, while behind a high-latency tunnel
+(~tens of ms RTT) the native host path wins at every size — forcing
+``backend="tpu"`` always bypasses the probe. Override with
+``PYRUHVRO_TPU_DEVICE_MIN_ROWS=<n>`` (device for batches ≥ n) and
+disable the native VM entirely with ``PYRUHVRO_TPU_NO_NATIVE=1``.
 """
 
 from __future__ import annotations
@@ -104,6 +114,50 @@ def _device_codec(entry: SchemaEntry, backend: str):
         return None
 
 
+def _native_host_codec(entry: SchemaEntry):
+    """The C++ host VM codec for this schema, or None (outside the fast
+    subset, no toolchain, or disabled via PYRUHVRO_TPU_NO_NATIVE)."""
+    import os
+
+    if os.environ.get("PYRUHVRO_TPU_NO_NATIVE"):
+        return None
+
+    def make():
+        try:
+            from .hostpath import NativeHostCodec
+
+            return NativeHostCodec(entry.ir, entry.arrow_schema)
+        except Exception:
+            # unsupported schema / missing toolchain: the Python
+            # fallback serves the call (reference silent-gate semantics)
+            return None
+
+    return entry.get_extra("native_host_codec", make)
+
+
+def _auto_prefers_host(entry: SchemaEntry, n_rows: int) -> bool:
+    """In ``backend="auto"`` with BOTH a device codec and the native host
+    VM available: route to host when the device cannot win.
+
+    The decision is a one-time interconnect RTT probe
+    (:func:`.ops.codec.interconnect_rtt_s`): a co-located accelerator
+    (sub-ms RTT) beats the single-core host VM from small sizes, so the
+    device keeps the batch; a remote tunnel (tens of ms per round trip,
+    ~30 MB/s) loses to the ~2M rec/s host VM at every batch size, so
+    host serves ``auto`` and ``backend="tpu"`` remains the explicit
+    override. ``PYRUHVRO_TPU_DEVICE_MIN_ROWS=<n>`` replaces the probe."""
+    import os
+
+    if _native_host_codec(entry) is None:
+        return False
+    env = os.environ.get("PYRUHVRO_TPU_DEVICE_MIN_ROWS")
+    if env:
+        return n_rows < int(env)
+    from .ops.codec import interconnect_remote
+
+    return interconnect_remote()
+
+
 _device_encode_spec = None
 
 
@@ -140,8 +194,13 @@ def deserialize_array(
     _check_backend(backend)
     entry = get_or_parse_schema(schema)
     codec = _device_codec(entry, backend)
-    if codec is not None:
+    if codec is not None and not (
+        backend == "auto" and _auto_prefers_host(entry, len(data))
+    ):
         return codec.decode(data)
+    native = _native_host_codec(entry)
+    if native is not None:
+        return native.decode(data)
     return decode_to_record_batch(
         data, entry.ir, entry.arrow_schema, _host_reader(entry)
     )
@@ -162,8 +221,13 @@ def deserialize_array_threaded(
     entry = get_or_parse_schema(schema)
     bounds = chunk_bounds(len(data), num_chunks)
     codec = _device_codec(entry, backend)
-    if codec is not None:
+    if codec is not None and not (
+        backend == "auto" and _auto_prefers_host(entry, len(data))
+    ):
         return codec.decode_threaded(data, num_chunks)
+    native = _native_host_codec(entry)
+    if native is not None:
+        return native.decode_threaded(data, num_chunks)
     ir, arrow, reader = entry.ir, entry.arrow_schema, _host_reader(entry)
     return map_chunks(
         lambda ab: decode_to_record_batch(data[ab[0]:ab[1]], ir, arrow, reader),
@@ -204,8 +268,13 @@ def serialize_record_batch(
         raise RuntimeError(
             "the device encode kernel is not available in this build"
         )
-    if codec is not None:
-        return [codec.encode(batch.slice(a, b - a)) for a, b in bounds]
+    if codec is not None and not (
+        backend == "auto" and _auto_prefers_host(entry, batch.num_rows)
+    ):
+        return codec.encode_threaded(batch, num_chunks)
+    native = _native_host_codec(entry)
+    if native is not None:
+        return native.encode_threaded(batch, num_chunks)
     ir = entry.ir
     plan = entry.get_extra("host_encode_plan", lambda: compile_encoder_plan(ir))
     def encode_chunk(ab):

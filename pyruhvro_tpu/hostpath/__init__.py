@@ -1,0 +1,15 @@
+"""Native host fast path (C++ bytecode VM).
+
+The host-side counterpart of the device pipeline: the same schema IR is
+lowered to a flat opcode program (:mod:`.program`) interpreted by the
+C++ VM (``runtime/native/host_codec.cpp``), emitting the device blob's
+named-column layout so :mod:`..ops.arrow_build` assembles both backends'
+output identically. ≙ the reference's L2a fast path
+(``ruhvro/src/fast_decode.rs``) in role; the architecture (linear
+bytecode + columnar builders, no per-field decoder objects) is this
+framework's own.
+"""
+
+from .codec import NativeHostCodec, native_available
+
+__all__ = ["NativeHostCodec", "native_available"]

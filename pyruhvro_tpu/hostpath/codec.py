@@ -1,0 +1,183 @@
+"""NativeHostCodec: decode Avro datums on the CPU through the C++ VM.
+
+The fast host path the public API routes to when no device wins (and
+the safety net behind it stays the pure-Python fallback decoder, which
+doubles as the differential oracle). Output equality with both other
+backends is guaranteed by construction: all three feed the same Arrow
+assembly (``ops/arrow_build.py``) or are differentially tested against
+it (``tests/test_hostpath.py``).
+
+≙ the reference's fast path position in the stack
+(``deserialize.rs:26-29`` gate → ``fast_decode.rs:806``), with the
+bytecode-VM architecture documented in :mod:`.program`.
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence
+
+import numpy as np
+import pyarrow as pa
+
+from ..fallback.io import MalformedAvro
+from ..ops.varint import ERR_NAMES
+from ..runtime.native.build import load_host_codec
+from ..runtime.pack import concat_records
+from .program import HostProgram, lower_host
+
+__all__ = ["NativeHostCodec", "native_available"]
+
+
+def native_available() -> bool:
+    """True when the C++ VM compiled/loaded (memoized by the builder)."""
+    return load_host_codec() is not None
+
+
+class NativeHostCodec:
+    """Schema-bound native decoder (per-schema program, compiled once).
+
+    Raises :class:`RuntimeError` when the native module is unavailable
+    and :class:`..ops.UnsupportedOnDevice` when the schema is outside
+    the fast subset — callers fall back to the Python decoder for both.
+    """
+
+    def __init__(self, ir, arrow_schema: pa.Schema):
+        self.ir = ir
+        self.arrow_schema = arrow_schema
+        self.prog: HostProgram = lower_host(ir)  # raises UnsupportedOnDevice
+        self._plan = self.prog.buffer_plan()
+        self._mod = load_host_codec()
+        if self._mod is None:
+            raise RuntimeError("native host codec unavailable (no toolchain)")
+
+    def decode(self, data: Sequence[bytes],
+               nthreads: int = 0) -> pa.RecordBatch:
+        from ..ops.arrow_build import build_record_batch
+        from ..runtime import metrics
+
+        n = len(data)
+        with metrics.timer("host.pack_s"):
+            flat, offsets = concat_records(data)
+        with metrics.timer("host.vm_s"):
+            bufs, err_rec, err_bits = self._mod.decode(
+                self.prog.ops, self.prog.coltypes, flat, offsets, n, nthreads
+            )
+        if err_rec >= 0:
+            bit = err_bits & -err_bits
+            raise MalformedAvro(
+                f"record {err_rec}: "
+                f"{ERR_NAMES.get(bit, f'error bit {bit:#x}')}"
+            )
+        host = {}
+        for (key, dt, _region), b in zip(self._plan, bufs):
+            host[key] = np.frombuffer(b, dtype=dt)
+        item_totals = {}
+        for path in self.prog.regions[1:]:
+            k = path + "#offsets"
+            # the VM returns running totals; Arrow offsets lead with 0
+            host[k] = np.concatenate([np.zeros(1, np.int32), host[k]])
+            item_totals[path] = int(host[k][-1])
+        meta = {"item_totals": item_totals, "flat": flat}
+        with metrics.timer("host.build_s"):
+            return build_record_batch(
+                self.ir, self.arrow_schema, host, n, meta
+            )
+
+    def decode_threaded(self, data: Sequence[bytes],
+                        num_chunks: int) -> List[pa.RecordBatch]:
+        """Chunked decode → one RecordBatch per chunk (reference chunk
+        slicing, ``deserialize.rs:57-68``); the VM threads shard rows
+        internally, so chunking here is only the return-shape contract."""
+        from ..runtime.chunking import chunk_bounds
+
+        bounds = chunk_bounds(len(data), num_chunks)
+        batch = self.decode(data)
+        return [batch.slice(a, b - a) for a, b in bounds]
+
+    # -- encode -----------------------------------------------------------
+
+    def _encode_buffers(self, ex) -> List[np.ndarray]:
+        """Map the shared Arrow extractor's per-path arrays
+        (``ops.encode.run_extractor``) onto the VM's plan buffer order."""
+        from .program import COL_F64, COL_I64, COL_OFFS, COL_STR
+
+        empty_u8 = np.zeros(0, np.uint8)
+        bufs: List[np.ndarray] = []
+        for c in self.prog.cols:
+            key, ctype = c.key, c.ctype
+            if ctype == COL_STR:
+                bufs.append(ex.byte_bufs.get(key + "#bytes", empty_u8))
+                bufs.append(ex.arrays[key + "#len"][0])
+            elif ctype == COL_OFFS:
+                path = key[: -len("#offsets")]
+                bufs.append(ex.arrays[path + "#count"][0])
+            elif ctype in (COL_I64, COL_F64):
+                # the shared extractor splits 64-bit values into u32
+                # halves for the device; the VM wants them whole
+                base = key[: -len("#v64")] + "#v"
+                lo = ex.arrays[base + ":lo"][0].astype(np.uint64)
+                hi = ex.arrays[base + ":hi"][0].astype(np.uint64)
+                whole = (hi << np.uint64(32)) | lo
+                view = np.int64 if ctype == COL_I64 else np.float64
+                bufs.append(np.ascontiguousarray(whole.view(view)))
+            else:  # #v / #valid / #tid — same keys both sides
+                bufs.append(ex.arrays[key][0])
+        return bufs
+
+    def encode(self, batch: pa.RecordBatch) -> pa.Array:
+        """Encode every row as one Avro datum → BinaryArray
+        (≙ ``serialize_chunk``, ``fast_encode.rs:27-52``). Raises
+        :class:`..ops.decode.BatchTooLarge` when the wire total blows
+        int32 binary offsets (callers split the batch)."""
+        from ..ops.decode import BatchTooLarge
+        from ..ops.encode import run_extractor
+        from ..runtime import metrics
+
+        n = batch.num_rows
+        if n == 0:
+            return pa.array([], pa.binary())
+        with metrics.timer("host.extract_s"):
+            ex = run_extractor(self.ir, batch)
+            bufs = self._encode_buffers(ex)
+        try:
+            with metrics.timer("host.encode_vm_s"):
+                blob, sizes = self._mod.encode(
+                    self.prog.ops, self.prog.coltypes, bufs, n
+                )
+        except OverflowError:
+            raise BatchTooLarge(n, -1)
+        sizes = np.frombuffer(sizes, np.int32)
+        offsets = np.zeros(n + 1, np.int32)
+        np.cumsum(sizes, out=offsets[1:])
+        return pa.Array.from_buffers(
+            pa.binary(), n,
+            [None, pa.py_buffer(offsets),
+             pa.py_buffer(np.frombuffer(blob, np.uint8))],
+        )
+
+    def encode_threaded(self, batch: pa.RecordBatch,
+                        num_chunks: int) -> List[pa.Array]:
+        """Encode ONCE, slice per chunk (one VM pass regardless of the
+        chunk count — the chunked return shape is an API contract, not a
+        unit of work). An oversized batch is split recursively, still
+        through the VM."""
+        from ..ops.decode import BatchTooLarge
+        from ..runtime.chunking import chunk_bounds
+
+        bounds = chunk_bounds(batch.num_rows, num_chunks)
+        arr = self._encode_split(batch)
+        return [arr.slice(a, b - a) for a, b in bounds]
+
+    def _encode_split(self, batch: pa.RecordBatch) -> pa.Array:
+        from ..ops.decode import BatchTooLarge
+
+        try:
+            return self.encode(batch)
+        except BatchTooLarge:
+            if batch.num_rows < 2:
+                raise
+            mid = batch.num_rows // 2
+            return pa.concat_arrays(
+                [self._encode_split(batch.slice(0, mid)),
+                 self._encode_split(batch.slice(mid))]
+            )

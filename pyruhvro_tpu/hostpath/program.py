@@ -1,0 +1,197 @@
+"""Schema IR → host bytecode program (the C++ VM's input).
+
+Mirrors the device lowering (:mod:`..ops.fieldprog`) in walk order and
+column naming — ``path#v`` / ``path#v64`` / ``path#valid`` /
+``path#tid`` / ``path#bytes``+``#len`` / ``path#offsets`` — so the
+VM's output dict drops straight into ``ops.arrow_build``. Op kinds and
+column-type codes are the C++ side's contract
+(``runtime/native/host_codec.cpp`` enums; keep in sync).
+
+≙ the role of ``make_decoder`` (``ruhvro/src/fast_decode.rs:176-420``):
+where the reference builds a tree of boxed decoder objects at runtime,
+this framework compiles the schema once into a flat program — the same
+"static field program" idea the device path uses, executed by switch
+dispatch instead of XLA.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, List, Tuple
+
+import numpy as np
+
+from ..ops import UnsupportedOnDevice
+from ..gate import is_supported
+from ..schema.model import (
+    Array,
+    AvroType,
+    Enum,
+    Map,
+    Primitive,
+    Record,
+    Union,
+)
+
+__all__ = ["HostProgram", "lower_host", "COL_NBUF"]
+
+# op kinds (≙ host_codec.cpp OpKind)
+OP_RECORD, OP_INT, OP_LONG, OP_FLOAT, OP_DOUBLE, OP_BOOL = 0, 1, 2, 3, 4, 5
+OP_STRING, OP_ENUM, OP_NULL, OP_NULLABLE, OP_UNION = 6, 7, 8, 9, 10
+OP_ARRAY, OP_MAP = 11, 12
+
+# column types (≙ host_codec.cpp ColType)
+COL_I32, COL_I64, COL_F32, COL_F64, COL_U8, COL_STR, COL_OFFS = range(7)
+
+# buffers each column type contributes (COL_STR: value bytes + len i32)
+COL_NBUF = {COL_STR: 2}
+
+# numpy dtypes per buffer, in buffer order
+_COL_DTYPES = {
+    COL_I32: (np.int32,),
+    COL_I64: (np.int64,),
+    COL_F32: (np.float32,),
+    COL_F64: (np.float64,),
+    COL_U8: (np.uint8,),
+    COL_STR: (np.uint8, np.int32),
+    COL_OFFS: (np.int32,),
+}
+
+
+@dataclass
+class ColSpec:
+    key: str       # assembler dict key ("" + suffix handled by builder)
+    ctype: int
+    region: int    # region id (0 = rows), for entry-count bookkeeping
+
+
+@dataclass
+class HostProgram:
+    ir: Record
+    ops: np.ndarray            # int32 [n_ops, 6]
+    cols: List[ColSpec]
+    coltypes: np.ndarray       # int32 [n_cols]
+    regions: List[str]         # region id -> repeated-field path
+    region_parents: List[int]
+
+    def buffer_plan(self) -> List[Tuple[str, object, int]]:
+        """Flat (host_key, dtype, region) per returned buffer, in the
+        VM's buffer order. Host keys: ``#start``/``#len`` suffixes for
+        strings, the col key otherwise."""
+        plan = []
+        for c in self.cols:
+            dts = _COL_DTYPES[c.ctype]
+            if c.ctype == COL_STR:
+                plan.append((c.key + "#bytes", dts[0], c.region))
+                plan.append((c.key + "#len", dts[1], c.region))
+            else:
+                plan.append((c.key, dts[0], c.region))
+        return plan
+
+
+class _HostLowering:
+    def __init__(self) -> None:
+        self.ops: List[Tuple[int, int, int, int]] = []  # kind,a,b,col
+        self.cols: List[ColSpec] = []
+        self.subtree: Dict[int, int] = {}  # op index -> nops
+        self.regions: List[str] = [""]
+        self.region_parents: List[int] = [-1]
+
+    def col(self, key: str, ctype: int, region: int) -> int:
+        self.cols.append(ColSpec(key, ctype, region))
+        return len(self.cols) - 1
+
+    def emit(self, kind: int, a: int = 0, b: int = 0, col: int = -1) -> int:
+        self.ops.append((kind, a, b, col))
+        i = len(self.ops) - 1
+        self.subtree[i] = 1
+        return i
+
+    def close(self, i: int) -> None:
+        self.subtree[i] = len(self.ops) - i
+
+    def lower_type(self, t: AvroType, path: str, region: int) -> None:
+        if isinstance(t, Primitive):
+            name = t.name
+            if name == "null":
+                self.emit(OP_NULL)
+            elif name == "int":
+                self.emit(OP_INT, col=self.col(path + "#v", COL_I32, region))
+            elif name == "long":
+                self.emit(OP_LONG, col=self.col(path + "#v64", COL_I64, region))
+            elif name == "float":
+                self.emit(OP_FLOAT, col=self.col(path + "#v", COL_F32, region))
+            elif name == "double":
+                self.emit(OP_DOUBLE,
+                          col=self.col(path + "#v64", COL_F64, region))
+            elif name == "boolean":
+                self.emit(OP_BOOL, col=self.col(path + "#v", COL_U8, region))
+            elif name == "string":
+                self.emit(OP_STRING, col=self.col(path, COL_STR, region))
+            else:  # pragma: no cover — gated by is_supported
+                raise UnsupportedOnDevice(f"primitive {name!r} at {path!r}")
+        elif isinstance(t, Enum):
+            self.emit(OP_ENUM, a=len(t.symbols),
+                      col=self.col(path + "#v", COL_I32, region))
+        elif isinstance(t, Record):
+            i = self.emit(OP_RECORD)
+            prefix = path + "/" if path else ""
+            for f in t.fields:
+                self.lower_type(f.type, prefix + f.name, region)
+            self.close(i)
+        elif isinstance(t, Union):
+            if t.is_nullable_pair:
+                i = self.emit(
+                    OP_NULLABLE, a=t.null_index,
+                    col=self.col(path + "#valid", COL_U8, region),
+                )
+                self.lower_type(t.non_null_variant, path, region)
+                self.close(i)
+            else:
+                i = self.emit(
+                    OP_UNION, a=len(t.variants),
+                    col=self.col(path + "#tid", COL_I32, region),
+                )
+                for k, v in enumerate(t.variants):
+                    if v.is_null():
+                        self.emit(OP_NULL)
+                    else:
+                        self.lower_type(v, f"{path}/{k}", region)
+                self.close(i)
+        elif isinstance(t, (Array, Map)):
+            rid = len(self.regions)
+            self.regions.append(path)
+            self.region_parents.append(region)
+            offs = self.col(path + "#offsets", COL_OFFS, region)
+            if isinstance(t, Array):
+                i = self.emit(OP_ARRAY, col=offs)
+                self.lower_type(t.items, path + "/@item", rid)
+            else:
+                key_col = self.col(path + "/@key", COL_STR, rid)
+                i = self.emit(OP_MAP, b=key_col, col=offs)
+                self.lower_type(t.values, path + "/@val", rid)
+            self.close(i)
+        else:  # pragma: no cover — gated by is_supported
+            raise UnsupportedOnDevice(f"type {type(t).__name__} at {path!r}")
+
+
+def lower_host(ir: AvroType) -> HostProgram:
+    """Lower a top-level record schema to its host bytecode program."""
+    if not is_supported(ir):
+        raise UnsupportedOnDevice("schema is outside the fast-path subset")
+    lo = _HostLowering()
+    lo.lower_type(ir, "", 0)
+    n = len(lo.ops)
+    ops = np.zeros((n, 6), np.int32)
+    for i, (kind, a, b, col) in enumerate(lo.ops):
+        ops[i] = (kind, a, b, col, lo.subtree[i], 0)
+    return HostProgram(
+        ir=ir,
+        ops=np.ascontiguousarray(ops),
+        cols=lo.cols,
+        coltypes=np.ascontiguousarray(
+            np.array([c.ctype for c in lo.cols], np.int32)
+        ),
+        regions=lo.regions,
+        region_parents=lo.region_parents,
+    )

@@ -135,17 +135,23 @@ class _Assembler:
         if name == "null":
             return pa.nulls(count, pa.null())
         if name == "string":
-            # values are gathered here, on the host, from the original
-            # datum bytes — they never cross the device interconnect
-            starts = self.host[path + "#start"][:count]
             lens = self.host[path + "#len"][:count]
             voff = np.zeros(count + 1, np.int32)
             np.cumsum(lens, out=voff[1:])
             total = int(voff[count])
-            src = np.repeat(
-                starts.astype(np.int64) - voff[:-1], lens
-            ) + np.arange(total, dtype=np.int64)
-            values = self.flat[src]
+            if path + "#bytes" in self.host:
+                # the native host VM copies value bytes contiguously
+                # during its walk; use them directly
+                values = self.host[path + "#bytes"][:total]
+            else:
+                # device walk ships (start, len) only: values are
+                # gathered here, on the host, from the original datum
+                # bytes — they never cross the device interconnect
+                starts = self.host[path + "#start"][:count]
+                src = np.repeat(
+                    starts.astype(np.int64) - voff[:-1], lens
+                ) + np.arange(total, dtype=np.int64)
+                values = self.flat[src]
             _check_utf8(values, voff, path)
             return pa.Array.from_buffers(
                 dt, count,
@@ -165,10 +171,16 @@ class _Assembler:
                 dt, count, [vbuf, pa.py_buffer(arr)], null_count=nulls
             )
         if name == "long":
-            arr = _combine64(
-                self.col(path + "#lo", count), self.col(path + "#hi", count),
-                np.int64,
-            )
+            # device walk ships (lo, hi) u32 lanes; the native host VM
+            # writes int64 directly under "#v64"
+            if path + "#v64" in self.host:
+                arr = self.col(path + "#v64", count)
+            else:
+                arr = _combine64(
+                    self.col(path + "#lo", count),
+                    self.col(path + "#hi", count),
+                    np.int64,
+                )
             return pa.Array.from_buffers(
                 dt, count, [vbuf, pa.py_buffer(arr)], null_count=nulls
             )
@@ -178,10 +190,14 @@ class _Assembler:
                 dt, count, [vbuf, pa.py_buffer(arr)], null_count=nulls
             )
         if name == "double":
-            arr = _combine64(
-                self.col(path + "#lo", count), self.col(path + "#hi", count),
-                np.float64,
-            )
+            if path + "#v64" in self.host:
+                arr = self.col(path + "#v64", count)
+            else:
+                arr = _combine64(
+                    self.col(path + "#lo", count),
+                    self.col(path + "#hi", count),
+                    np.float64,
+                )
             return pa.Array.from_buffers(
                 dt, count, [vbuf, pa.py_buffer(arr)], null_count=nulls
             )

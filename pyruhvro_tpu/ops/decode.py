@@ -44,6 +44,7 @@ __all__ = [
     "BatchTooLarge",
     "split_blob",
     "pad_views",
+    "pack_launch_input",
 ]
 
 
@@ -109,6 +110,30 @@ def pad_views(flat: np.ndarray, offsets: np.ndarray, n: int, R: int, B: int):
     lengths = np.zeros(R, np.int32)
     lengths[:n] = np.diff(offsets).astype(np.int32)
     return words, starts, lengths, flat
+
+
+def pack_launch_input(words, starts, lengths, n: int) -> np.ndarray:
+    """Fuse the four launch inputs into ONE uint32 host buffer
+    ``[words | starts | lengths | n]`` — a single ``device_put`` per
+    decode call (each extra array is an extra transfer; see
+    ``_pipeline_fn``)."""
+    return np.concatenate([
+        words,
+        starts.view(np.uint32),
+        lengths.view(np.uint32),
+        np.array([n], np.uint32),
+    ])
+
+
+def unpack_launch_input(jnp, lax, buf, W: int, R: int):
+    """Traced inverse of :func:`pack_launch_input` — the single place
+    that knows the packed layout (used by the single-device jit wrapper
+    and the ``shard_map`` per-shard body)."""
+    words = buf[:W]
+    starts = lax.bitcast_convert_type(buf[W : W + R], jnp.int32)
+    lengths = lax.bitcast_convert_type(buf[W + R : W + 2 * R], jnp.int32)
+    n = lax.bitcast_convert_type(buf[W + 2 * R], jnp.int32)
+    return words, starts, lengths, n
 
 _DEFAULT_ITEM_CAP = 8
 _DEFAULT_TOT_CAP = 8
@@ -336,13 +361,27 @@ class DeviceDecoder:
     def _pipeline_fn(self, R: int, B: int, item_caps: Tuple[int, ...],
                      tot_caps: Tuple[int, ...]):
         """Jitted-and-cached :meth:`build_pipeline` (one compile per
-        (R, B, caps) bucket for the process, ≙ the schema→kernel cache)."""
+        (R, B, caps) bucket for the process, ≙ the schema→kernel cache).
+
+        The jitted callable takes ONE packed uint32 buffer
+        ``[words | starts | lengths | n]`` (see :func:`pack_launch_input`)
+        instead of four arrays: each separate jit argument is a separate
+        transfer, and on a high-latency interconnect a fresh numpy
+        scalar argument alone costs a full synchronous round trip
+        (measured ~65 ms through a device tunnel — BENCH_NOTES.md)."""
         key = (R, B, item_caps, tot_caps)
         hit = self._pipe_cache.get(key)
         if hit is not None:
             return hit
         pipeline, layout = self.build_pipeline(R, B, item_caps, tot_caps)
-        pair = (self._jax.jit(pipeline), layout)
+        jnp = self._jax.numpy
+        lax = self._jax.lax
+        W = B // 4
+
+        def packed(buf):
+            return pipeline(*unpack_launch_input(jnp, lax, buf, W, R))
+
+        pair = (self._jax.jit(packed), layout)
         with self._lock:
             self._pipe_cache[key] = pair
         return pair
@@ -487,16 +526,11 @@ class DeviceDecoder:
         R = bucket_len(max(n, 1), minimum=8)
         self.seed_caps_from_sample(data, R)
         words, starts, lengths, flat = pad_views(flat, offsets, n, R, B)
+        packed = pack_launch_input(words, starts, lengths, n)
 
         with metrics.timer("decode.h2d_s"):
-            words_d = jax.device_put(words)
-            starts_d = jax.device_put(starts)
-            lengths_d = jax.device_put(lengths)
-        metrics.inc(
-            "decode.h2d_bytes",
-            words.nbytes + starts.nbytes + lengths.nbytes,
-        )
-        n_d = np.int32(n)
+            packed_d = jax.device_put(packed)
+        metrics.inc("decode.h2d_bytes", packed.nbytes)
 
         prog = self.prog
         host = None
@@ -506,9 +540,13 @@ class DeviceDecoder:
             item_caps, tot_caps = self.caps_snapshot(R)
             fresh = (R, B, item_caps, tot_caps) not in self._pipe_cache
             fn, layout = self._pipeline_fn(R, B, item_caps, tot_caps)
+            # async dispatch; the device_get below is the ONLY
+            # synchronization of the call — an intermediate
+            # block_until_ready would cost a second full round trip on a
+            # high-latency interconnect (BENCH_NOTES.md). launch_s is
+            # therefore dispatch-only; d2h_s carries the wait.
             t0 = time.perf_counter()
-            res = fn(words_d, starts_d, lengths_d, n_d)
-            res.block_until_ready()
+            res = fn(packed_d)
             dt = time.perf_counter() - t0
             if fresh:  # first call pays trace+XLA-compile; track apart
                 metrics.inc("decode.compiles")
@@ -536,10 +574,15 @@ class DeviceDecoder:
             raise MalformedAvro("array/map item capacity did not converge")
 
         if host["#red:err"][0]:
+            # rare path (malformed batch): re-put the unpacked inputs for
+            # the walk-only error pass
             err = np.asarray(
                 jax.device_get(
                     self._err_fn(R, B, item_caps)(
-                        words_d, starts_d, lengths_d, n_d
+                        jax.device_put(words),
+                        jax.device_put(starts),
+                        jax.device_put(lengths),
+                        np.int32(n),
                     )
                 )
             )[:n]

@@ -761,12 +761,11 @@ class _Extractor:
             self.extract(t.values, vals, path + "/@val", rid, item_parent)
 
 
-def extract_batch(prog: EncProgram, batch: pa.RecordBatch,
-                  ir: Record) -> Tuple[Dict[str, np.ndarray], int]:
-    """Arrow batch → padded device-input dict + output byte bound.
-
-    Columns are matched by NAME (missing → error, extras ignored),
-    exactly like the oracle and the reference
+def run_extractor(ir: Record, batch: pa.RecordBatch) -> "_Extractor":
+    """Column-match an Arrow batch against the schema and walk it into
+    per-path numpy arrays (shared by the device encoder and the native
+    host encoder). Columns are matched by NAME (missing → error, extras
+    ignored), exactly like the oracle and the reference
     (``serialization_containers.rs:248-267``)."""
     from ..fallback.encoder import _types_compatible
     from ..schema.arrow_map import to_arrow_field
@@ -792,6 +791,13 @@ def extract_batch(prog: EncProgram, batch: pa.RecordBatch,
         cols, names=[f.name for f in ir.fields]
     ) if cols else pa.array([{}] * batch.num_rows, pa.struct([]))
     ex.extract(ir, struct, "", ROWS, None)
+    return ex
+
+
+def extract_batch(prog: EncProgram, batch: pa.RecordBatch,
+                  ir: Record) -> Tuple[Dict[str, np.ndarray], int]:
+    """Arrow batch → padded device-input dict + output byte bound."""
+    ex = run_extractor(ir, batch)
 
     if ex.regions != prog.regions:  # pragma: no cover — same walk order
         raise AssertionError("extractor/lowering region mismatch")

@@ -26,5 +26,6 @@ This module is exercised three ways (SURVEY.md §4.7):
 """
 
 from .sharded import ShardedDecoder, chunk_mesh
+from .sharded_encode import ShardedEncoder
 
-__all__ = ["ShardedDecoder", "chunk_mesh"]
+__all__ = ["ShardedDecoder", "ShardedEncoder", "chunk_mesh"]

@@ -1,9 +1,10 @@
-"""Build the native host shim on demand.
+"""Build the native host modules on demand.
 
-No pybind11 in this environment, so ``packer.cpp`` uses the raw CPython C
-API and we compile it directly with g++ into an extension module next to
+No pybind11 in this environment, so the C++ sources use the raw CPython C
+API and we compile them directly with g++ into extension modules next to
 this file. Build happens at first import (cached by mtime); failures are
-non-fatal — ``runtime.pack`` falls back to vectorized numpy.
+non-fatal — callers fall back (``runtime.pack`` to vectorized numpy, the
+host codec to the pure-Python fallback decoder).
 """
 
 from __future__ import annotations
@@ -15,27 +16,25 @@ import sysconfig
 import threading
 
 _HERE = os.path.dirname(os.path.abspath(__file__))
-_SRC = os.path.join(_HERE, "packer.cpp")
 _lock = threading.Lock()
-_module = None
-_tried = False
+_modules: dict = {}
 
 
-def _so_path() -> str:
+def _so_path(mod_name: str) -> str:
     suffix = sysconfig.get_config_var("EXT_SUFFIX") or ".so"
-    return os.path.join(_HERE, "_pyruhvro_native" + suffix)
+    return os.path.join(_HERE, mod_name + suffix)
 
 
-def _needs_build(so: str) -> bool:
-    return (not os.path.exists(so)) or os.path.getmtime(so) < os.path.getmtime(_SRC)
+def _needs_build(so: str, src: str) -> bool:
+    return (not os.path.exists(so)) or os.path.getmtime(so) < os.path.getmtime(src)
 
 
-def _compile(so: str) -> None:
+def _compile(so: str, src: str) -> None:
     include = sysconfig.get_paths()["include"]
     tmp = f"{so}.{os.getpid()}.tmp"  # per-process: concurrent builds can't clobber
     cmd = [
         "g++", "-O3", "-shared", "-fPIC", "-std=c++17", "-pthread",
-        "-I", include, _SRC, "-o", tmp,
+        "-I", include, src, "-o", tmp,
     ]
     try:
         subprocess.run(cmd, check=True, capture_output=True, text=True)
@@ -45,41 +44,50 @@ def _compile(so: str) -> None:
             os.unlink(tmp)
 
 
-def load_native():
-    """Return the compiled ``_pyruhvro_native`` module, or None if the
-    toolchain is unavailable."""
-    global _module, _tried
-    if _module is not None or _tried:
-        return _module
+def _load(mod_name: str, src_file: str):
+    """Compile-if-stale and import one extension module (memoized;
+    None is memoized too so a broken toolchain is probed once)."""
+    if mod_name in _modules:
+        return _modules[mod_name]
     with _lock:
-        if _module is not None or _tried:
-            return _module
-        _tried = True
-        so = _so_path()
+        if mod_name in _modules:
+            return _modules[mod_name]
+        so = _so_path(mod_name)
+        src = os.path.join(_HERE, src_file)
         try:
-            if _needs_build(so):
+            if _needs_build(so, src):
                 try:
-                    _compile(so)
+                    _compile(so, src)
                 except Exception as e:
                     # a wheel-built .so in a read-only site-packages can
                     # trip the mtime check (install order) yet be
                     # perfectly usable — prefer loading it over nothing,
-                    # but never silently: a dev editing packer.cpp must
+                    # but never silently: a dev editing the .cpp must
                     # see that the stale binary is still in use
                     if not os.path.exists(so):
                         raise
                     import warnings
 
                     warnings.warn(
-                        f"pyruhvro_tpu: rebuilding the native packer "
-                        f"failed ({e!r}); using the existing (possibly "
-                        f"stale) {os.path.basename(so)}",
+                        f"pyruhvro_tpu: rebuilding {src_file} failed "
+                        f"({e!r}); using the existing (possibly stale) "
+                        f"{os.path.basename(so)}",
                         RuntimeWarning,
                     )
-            spec = importlib.util.spec_from_file_location("_pyruhvro_native", so)
+            spec = importlib.util.spec_from_file_location(mod_name, so)
             mod = importlib.util.module_from_spec(spec)
             spec.loader.exec_module(mod)
-            _module = mod
+            _modules[mod_name] = mod
         except Exception:
-            _module = None
-        return _module
+            _modules[mod_name] = None
+        return _modules[mod_name]
+
+
+def load_native():
+    """The list[bytes] packer shim, or None if the toolchain is missing."""
+    return _load("_pyruhvro_native", "packer.cpp")
+
+
+def load_host_codec():
+    """The host decode/encode VM, or None if the toolchain is missing."""
+    return _load("_pyruhvro_hostcodec", "host_codec.cpp")

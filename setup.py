@@ -18,6 +18,13 @@ setup(
             language="c++",
             extra_compile_args=["-O3", "-std=c++17", "-pthread"],
             optional=True,
-        )
+        ),
+        Extension(
+            "pyruhvro_tpu.runtime.native._pyruhvro_hostcodec",
+            sources=["pyruhvro_tpu/runtime/native/host_codec.cpp"],
+            language="c++",
+            extra_compile_args=["-O3", "-std=c++17", "-pthread"],
+            optional=True,
+        ),
     ],
 )

@@ -1,0 +1,199 @@
+"""Native host VM (C++ bytecode decoder) — differential + behavior tests.
+
+Test strategy ≙ the reference's (SURVEY.md §4): the fast path is
+asserted byte-for-byte equal to the baseline ``Value``-tree decoder on
+generated inputs (``fast_decode.rs:945-953``), plus malformed-input and
+golden-datum checks.
+"""
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from pyruhvro_tpu.fallback.decoder import decode_to_record_batch
+from pyruhvro_tpu.fallback.io import MalformedAvro
+from pyruhvro_tpu.hostpath import NativeHostCodec, native_available
+from pyruhvro_tpu.schema.cache import get_or_parse_schema
+from pyruhvro_tpu.utils.datagen import (
+    CRITERION_SHAPES,
+    KAFKA_SCHEMA_JSON,
+    kafka_style_datums,
+    random_datums,
+)
+
+pytestmark = pytest.mark.skipif(
+    not native_available(), reason="native toolchain unavailable"
+)
+
+
+def _codec(schema_str):
+    e = get_or_parse_schema(schema_str)
+    return e, NativeHostCodec(e.ir, e.arrow_schema)
+
+
+@pytest.mark.parametrize("name", ["kafka"] + list(CRITERION_SHAPES))
+def test_differential_vs_oracle(name):
+    schema = KAFKA_SCHEMA_JSON if name == "kafka" else CRITERION_SHAPES[name]
+    e, c = _codec(schema)
+    datums = (
+        kafka_style_datums(700, seed=3)
+        if name == "kafka"
+        else random_datums(e.ir, 700, seed=9)
+    )
+    got = c.decode(datums)
+    want = decode_to_record_batch(datums, e.ir, e.arrow_schema)
+    assert got.equals(want)
+
+
+def test_multithreaded_merge_matches_single():
+    """Shard merge (incl. list-offset rebasing) vs one shard."""
+    e, c = _codec(KAFKA_SCHEMA_JSON)
+    datums = kafka_style_datums(501, seed=5)  # uneven shard split
+    assert c.decode(datums, nthreads=4).equals(c.decode(datums, nthreads=1))
+
+
+def test_empty_and_single():
+    e, c = _codec(KAFKA_SCHEMA_JSON)
+    assert c.decode([]).num_rows == 0
+    datums = kafka_style_datums(1, seed=11)
+    assert c.decode(datums).equals(
+        decode_to_record_batch(datums, e.ir, e.arrow_schema)
+    )
+
+
+def test_chunked_return_shape():
+    _, c = _codec(KAFKA_SCHEMA_JSON)
+    datums = kafka_style_datums(10, seed=2)
+    out = c.decode_threaded(datums, 3)
+    # reference slicing: even chunks, remainder to the LAST chunk
+    assert [b.num_rows for b in out] == [3, 3, 4]
+
+
+STRING_SCHEMA = (
+    '{"type":"record","name":"S","fields":[{"name":"s","type":"string"}]}'
+)
+
+
+def test_malformed_inputs_raise():
+    e, c = _codec(KAFKA_SCHEMA_JSON)
+    good = kafka_style_datums(4, seed=7)
+    with pytest.raises(MalformedAvro, match="record 2"):
+        c.decode(good[:2] + [good[2][:3]] + good[3:])
+    with pytest.raises(MalformedAvro):  # trailing garbage
+        c.decode([good[0] + b"\x00"])
+
+
+def test_malformed_string_cases():
+    _, c = _codec(STRING_SCHEMA)
+    with pytest.raises(MalformedAvro, match="negative"):
+        c.decode([b"\x01"])  # zigzag -1 length
+    with pytest.raises(MalformedAvro, match="past end"):
+        c.decode([b"\x08ab"])  # declared 4 bytes, only 2 present
+    with pytest.raises(MalformedAvro, match="UTF-8"):
+        c.decode([b"\x04\xff\xfe"])  # 2 bytes, invalid UTF-8
+
+
+def test_long_values_roundtrip_64bit():
+    schema = (
+        '{"type":"record","name":"L","fields":[{"name":"v","type":"long"}]}'
+    )
+    e, c = _codec(schema)
+    vals = [0, 1, -1, 2**62, -(2**62), 2**63 - 1, -(2**63)]
+    from pyruhvro_tpu.fallback.encoder import (
+        compile_encoder_plan,
+        encode_record_batch,
+    )
+
+    batch = pa.RecordBatch.from_pydict({"v": pa.array(vals, pa.int64())})
+    datums = encode_record_batch(batch, e.ir, compile_encoder_plan(e.ir))
+    got = c.decode([bytes(d) for d in datums])
+    assert got.column(0).to_pylist() == vals
+
+
+def test_api_routes_host_backend_through_vm(monkeypatch):
+    """backend='host' serves from the native VM (observable via the
+    host.vm_s phase counter), and PYRUHVRO_TPU_NO_NATIVE disables it."""
+    from pyruhvro_tpu import metrics
+    from pyruhvro_tpu.api import deserialize_array
+    from pyruhvro_tpu.schema import cache as cache_mod
+
+    datums = kafka_style_datums(50, seed=13)
+    entry = get_or_parse_schema(KAFKA_SCHEMA_JSON)
+    metrics.reset()
+    a = deserialize_array(datums, KAFKA_SCHEMA_JSON, backend="host")
+    assert metrics.snapshot().get("host.vm_s", 0) > 0
+
+    monkeypatch.setenv("PYRUHVRO_TPU_NO_NATIVE", "1")
+    monkeypatch.setitem(entry._extras, "native_host_codec", None)
+    metrics.reset()
+    b = deserialize_array(datums, KAFKA_SCHEMA_JSON, backend="host")
+    assert metrics.snapshot().get("host.vm_s", 0) == 0
+    assert a.equals(b)
+
+
+@pytest.mark.parametrize("name", ["kafka"] + list(CRITERION_SHAPES))
+def test_encode_wire_exact(name):
+    """decode → VM encode reproduces the original wire bytes exactly."""
+    schema = KAFKA_SCHEMA_JSON if name == "kafka" else CRITERION_SHAPES[name]
+    e, c = _codec(schema)
+    datums = (
+        kafka_style_datums(300, seed=4)
+        if name == "kafka"
+        else random_datums(e.ir, 300, seed=10)
+    )
+    batch = decode_to_record_batch(datums, e.ir, e.arrow_schema)
+    assert [bytes(x) for x in c.encode(batch)] == [bytes(d) for d in datums]
+
+
+def test_encode_threaded_slices_one_pass():
+    e, c = _codec(KAFKA_SCHEMA_JSON)
+    datums = kafka_style_datums(10, seed=6)
+    batch = decode_to_record_batch(datums, e.ir, e.arrow_schema)
+    out = c.encode_threaded(batch, 4)
+    assert [len(a) for a in out] == [2, 2, 2, 4]
+    assert [bytes(x) for a in out for x in a] == [bytes(d) for d in datums]
+
+
+def test_encode_error_parity_with_oracle():
+    """Missing column / null at non-nullable position raise ValueError
+    like the fallback encoder (reference column matching,
+    serialization_containers.rs:248-267)."""
+    e, c = _codec(STRING_SCHEMA)
+    with pytest.raises(ValueError, match="missing column"):
+        c.encode(pa.RecordBatch.from_pydict({"t": pa.array(["x"])}))
+    with pytest.raises(ValueError, match="null value"):
+        c.encode(
+            pa.RecordBatch.from_pydict(
+                {"s": pa.array(["a", None], pa.utf8())}
+            )
+        )
+
+
+def test_api_serialize_host_routes_through_vm():
+    from pyruhvro_tpu import metrics
+    from pyruhvro_tpu.api import serialize_record_batch
+
+    e, c = _codec(KAFKA_SCHEMA_JSON)
+    datums = kafka_style_datums(40, seed=15)
+    batch = decode_to_record_batch(datums, e.ir, e.arrow_schema)
+    metrics.reset()
+    out = serialize_record_batch(batch, KAFKA_SCHEMA_JSON, 8, backend="host")
+    assert metrics.snapshot().get("host.encode_vm_s", 0) > 0
+    assert [bytes(x) for a in out for x in a] == [bytes(d) for d in datums]
+
+
+def test_deep_nesting_and_unions():
+    """Nested repetition + sparse unions through the VM vs oracle."""
+    schema = """
+    {"type":"record","name":"N","fields":[
+      {"name":"m","type":{"type":"map","values":
+          {"type":"array","items":["null","string","long"]}}},
+      {"name":"u","type":["boolean","double",
+          {"type":"record","name":"Inner","fields":[
+             {"name":"xs","type":{"type":"array","items":"int"}}]}]}
+    ]}"""
+    e, c = _codec(schema)
+    datums = random_datums(e.ir, 400, seed=21)
+    got = c.decode(datums)
+    want = decode_to_record_batch(datums, e.ir, e.arrow_schema)
+    assert got.equals(want)

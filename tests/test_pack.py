@@ -53,13 +53,13 @@ def test_native_matches_numpy():
     # force numpy path by temporarily hiding the native module
     import pyruhvro_tpu.runtime.native.build as b
     tile_n, lens_n = pack.pack_padded(DATA)
-    saved = b._module
+    saved = dict(b._modules)
     try:
-        b._module = None
-        b._tried = True
+        b._modules["_pyruhvro_native"] = None
         tile_p, lens_p = pack.pack_padded(DATA)
     finally:
-        b._module = saved
+        b._modules.clear()
+        b._modules.update(saved)
     np.testing.assert_array_equal(tile_n, tile_p)
     np.testing.assert_array_equal(lens_n, lens_p)
 
