@@ -290,19 +290,24 @@ def main() -> None:
         _run_case("serialize", kafka, datums, backend, args.chunks,
                   args.reps, details)
 
-    # large-batch scaling point
-    if args.big_rows:
-        big = _gen_kafka(args.big_rows)
-        for backend in backends:
-            if backend == "host" and args.big_rows > args.host_cap:
-                continue
-            rec_s = _run_case("deserialize", kafka, big, backend,
-                              args.chunks, max(2, args.reps - 2), details,
-                              label="big/")
-            name = dev_name if backend == "tpu" else "host"
-            if rec_s and (headline is None or rec_s > headline[0]):
-                headline = (rec_s, name, args.big_rows)
-        del big
+    def _headline_line():
+        if headline is None:
+            return json.dumps({
+                "metric": "deserialize_kafka_rec_s", "value": 0.0,
+                "unit": "records/s", "vs_baseline": 0.0,
+            })
+        rec_s, name, rows = headline
+        return json.dumps({
+            "metric": f"deserialize_kafka_{name}_{rows}rows",
+            "value": round(rec_s, 1),
+            "unit": "records/s",
+            "vs_baseline": round(rec_s / BASELINE_DECODE_REC_S, 4),
+        })
+
+    # phase ordering is wedge-aware (BENCH_NOTES.md): every HOST phase
+    # runs before any long device-tunnel phase, and the headline line is
+    # re-printed after each phase, so a wedged tunnel case mid-run still
+    # leaves the best-so-far headline as the last stdout line.
 
     # north-star config (BASELINE.md): 10M rows, single chip/host.
     # The native host VM serves it; without the VM (no toolchain /
@@ -326,23 +331,28 @@ def main() -> None:
                     and (headline is None or rec_s > headline[0])):
                 headline = (rec_s, "host", args.north_star)
         del ns
+        save_details()
+        print(_headline_line(), flush=True)
+
+    # large-batch scaling point (host before the tunnel-bound device)
+    if args.big_rows:
+        big = _gen_kafka(args.big_rows)
+        for backend in [b for b in backends if b == "host"] + [
+            b for b in backends if b != "host"
+        ]:
+            if backend == "host" and args.big_rows > args.host_cap:
+                continue
+            rec_s = _run_case("deserialize", kafka, big, backend,
+                              args.chunks, max(2, args.reps - 2), details,
+                              label="big/")
+            name = dev_name if backend == "tpu" else "host"
+            if rec_s and (headline is None or rec_s > headline[0]):
+                headline = (rec_s, name, args.big_rows)
+        del big
 
     save_details()
-    if headline is None:
-        headline_json = json.dumps({
-            "metric": "deserialize_kafka_rec_s", "value": 0.0,
-            "unit": "records/s", "vs_baseline": 0.0,
-        })
-    else:
-        rec_s, name, rows = headline
-        headline_json = json.dumps({
-            "metric": f"deserialize_kafka_{name}_{rows}rows",
-            "value": round(rec_s, 1),
-            "unit": "records/s",
-            "vs_baseline": round(rec_s / BASELINE_DECODE_REC_S, 4),
-        })
-    # early print = crash insurance if a later phase times out ...
-    print(headline_json, flush=True)
+    # crash insurance if a later phase wedges/times out ...
+    print(_headline_line(), flush=True)
 
     # criterion matrix: 4 shapes × {1k, 10k} × backends
     if args.matrix:
@@ -377,7 +387,7 @@ def main() -> None:
     save_details()
     # ... and the driver reads the LAST stdout line: print it (again)
     # as the final act (VERDICT r03: BENCH_r03.json parsed=null)
-    print(headline_json, flush=True)
+    print(_headline_line(), flush=True)
 
 
 def _bench_fastavro(schema, datums, reps, details):

@@ -205,6 +205,10 @@ class DeviceDecoder:
         ]
         # per-region item-total caps, remembered per R bucket
         self._tot_cap_mem: Dict[Tuple[int, int], int] = {}
+        # (R, B) buckets whose string lens overflowed the compact
+        # descriptor budget — remembered so they go straight to the
+        # full-width layout (see build_pipeline blob shrinking)
+        self._str_full: set = set()
         self._seed_tried: set = set()  # (R, rid) sampling attempts
         self._lock = threading.Lock()
 
@@ -242,13 +246,25 @@ class DeviceDecoder:
     # -- the fused pipeline ------------------------------------------------
 
     def build_pipeline(self, R: int, B: int, item_caps: Tuple[int, ...],
-                       tot_caps: Tuple[int, ...]):
+                       tot_caps: Tuple[int, ...],
+                       compact_strings: bool = True):
         """Build the (unjitted) fused walk+finalize. Returns
         ``(fn, layout)`` where ``fn(words, starts, lengths, n)`` yields
         ONE uint8 blob and ``layout`` is ``[(key, dtype, length), ...]``
         for the host split. The blob also carries the reductions (error
         flag, per-region item max/sum) so the steady state costs a single
         device round trip.
+
+        Blob shrinking (the d2h direction is the expensive one —
+        BENCH_NOTES.md): string ``(start, len)`` descriptor pairs are
+        the bulk of the blob, so with ``compact_strings`` they ship as
+        ONE u32 ``start | len << 21`` when ``B ≤ 2^20`` (lens < 2^11,
+        "sl32" mode) or with u16 lens otherwise (lens < 2^16, "len16"
+        mode); a ``#red:strfit`` reduction reports when a batch's lens
+        exceed the mode's budget and the caller retries with
+        ``compact_strings=False`` (same ladder as capacity growth).
+        Validity and boolean lanes always bit-pack 8:1 (``…@bits``).
+        :meth:`expand_host` undoes all of it after the transfer.
 
         The raw callable is what :mod:`..parallel` ``shard_map``s over a
         device mesh (each mesh shard runs it on its chunk) and what
@@ -258,6 +274,10 @@ class DeviceDecoder:
         jnp = jax.numpy
         lax = jax.lax
         prog = self.prog
+        str_mode = None
+        if compact_strings and prog.string_cols:
+            str_mode = "sl32" if B <= (1 << 20) else "len16"
+        len_limit = (1 << 11) if str_mode == "sl32" else (1 << 16)
 
         item_buffers = {
             rid: sorted(
@@ -328,6 +348,31 @@ class DeviceDecoder:
                 .reshape(1)
                 .astype(jnp.uint8)
             )
+            # blob shrinking (see docstring): compact string descriptors…
+            if str_mode is not None:
+                fit = jnp.bool_(True)
+                for sc in prog.string_cols:
+                    fit = fit & (
+                        jnp.max(out[sc.path + "#len"]) < len_limit
+                    )
+                out["#red:strfit"] = fit.reshape(1).astype(jnp.uint8)
+                for sc in prog.string_cols:
+                    s = out.pop(sc.path + "#start")
+                    ln = out.pop(sc.path + "#len")
+                    if str_mode == "sl32":
+                        out[sc.path + "#sl"] = (
+                            s.astype(jnp.uint32)
+                            | (ln.astype(jnp.uint32) << 21)
+                        )
+                    else:
+                        out[sc.path + "#start"] = s
+                        out[sc.path + "#lenc"] = ln.astype(jnp.uint16)
+            # …and bit-pack every u8 payload lane (validity, booleans)
+            for k in list(out):
+                if not k.startswith("#red:") and out[k].dtype == jnp.uint8:
+                    out[k + "@bits"] = jnp.packbits(
+                        out.pop(k), bitorder="little"
+                    )
             # one blob, one transfer
             chunks = []
             for k in sorted(out):
@@ -355,11 +400,47 @@ class DeviceDecoder:
             if spec.region == ROWS and spec.key.rpartition("#")[2] != "count":
                 sizes[spec.key] = (np.dtype(spec.dtype), R)
         sizes["#red:err"] = (np.uint8, 1)
+        # mirror the pipeline's blob-shrinking transforms exactly
+        if str_mode is not None:
+            sizes["#red:strfit"] = (np.uint8, 1)
+            for sc in prog.string_cols:
+                _dt, ln_s = sizes.pop(sc.path + "#start")
+                sizes.pop(sc.path + "#len")
+                if str_mode == "sl32":
+                    sizes[sc.path + "#sl"] = (np.uint32, ln_s)
+                else:
+                    sizes[sc.path + "#start"] = (np.int32, ln_s)
+                    sizes[sc.path + "#lenc"] = (np.uint16, ln_s)
+        for k in list(sizes):
+            dt, ln = sizes[k]
+            if not k.startswith("#red:") and np.dtype(dt) == np.uint8:
+                del sizes[k]
+                sizes[k + "@bits"] = (np.uint8, ln // 8)
         layout = [(k,) + sizes[k] for k in sorted(sizes)]
         return pipeline, layout
 
+    @staticmethod
+    def expand_host(host: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
+        """Undo :meth:`build_pipeline`'s blob shrinking on the host dict
+        (vectorized, µs-scale) so the Arrow assembly sees the standard
+        ``#start``/``#len``/u8-lane keys."""
+        for k in list(host):
+            if k.endswith("@bits"):
+                host[k[:-5]] = np.unpackbits(host[k], bitorder="little")
+            elif k.endswith("#sl"):
+                v = host[k]
+                p = k[: -len("#sl")]
+                host[p + "#start"] = (
+                    v & np.uint32((1 << 21) - 1)
+                ).astype(np.int32)
+                host[p + "#len"] = (v >> np.uint32(21)).astype(np.int32)
+            elif k.endswith("#lenc"):
+                host[k[: -len("#lenc")] + "#len"] = host[k].astype(np.int32)
+        return host
+
     def _pipeline_fn(self, R: int, B: int, item_caps: Tuple[int, ...],
-                     tot_caps: Tuple[int, ...]):
+                     tot_caps: Tuple[int, ...],
+                     compact_strings: bool = True):
         """Jitted-and-cached :meth:`build_pipeline` (one compile per
         (R, B, caps) bucket for the process, ≙ the schema→kernel cache).
 
@@ -369,11 +450,13 @@ class DeviceDecoder:
         transfer, and on a high-latency interconnect a fresh numpy
         scalar argument alone costs a full synchronous round trip
         (measured ~65 ms through a device tunnel — BENCH_NOTES.md)."""
-        key = (R, B, item_caps, tot_caps)
+        key = (R, B, item_caps, tot_caps, compact_strings)
         hit = self._pipe_cache.get(key)
         if hit is not None:
             return hit
-        pipeline, layout = self.build_pipeline(R, B, item_caps, tot_caps)
+        pipeline, layout = self.build_pipeline(
+            R, B, item_caps, tot_caps, compact_strings
+        )
         jnp = self._jax.numpy
         lax = self._jax.lax
         W = B // 4
@@ -538,8 +621,13 @@ class DeviceDecoder:
         # ~cap-at-a-time, so cap growth can take ~log2(_MAX_ITEM_CAP) rounds
         for _attempt in range(24):
             item_caps, tot_caps = self.caps_snapshot(R)
-            fresh = (R, B, item_caps, tot_caps) not in self._pipe_cache
-            fn, layout = self._pipeline_fn(R, B, item_caps, tot_caps)
+            compact = (R, B) not in self._str_full
+            fresh = (
+                (R, B, item_caps, tot_caps, compact)
+                not in self._pipe_cache
+            )
+            fn, layout = self._pipeline_fn(R, B, item_caps, tot_caps,
+                                           compact)
             # async dispatch; the device_get below is the ONLY
             # synchronization of the call — an intermediate
             # block_until_ready would cost a second full round trip on a
@@ -558,6 +646,11 @@ class DeviceDecoder:
                 blob = np.asarray(jax.device_get(res))
             metrics.inc("decode.d2h_bytes", blob.nbytes)
             host = split_blob(blob, layout)
+            if compact and "#red:strfit" in host and not host["#red:strfit"][0]:
+                # a string overflowed the compact descriptor budget:
+                # remember and relaunch this bucket full-width
+                self._str_full.add((R, B))
+                continue
             red_max = {
                 rid: int(host["#red:max:" + path][0])
                 for rid, path in enumerate(prog.regions)
@@ -573,6 +666,7 @@ class DeviceDecoder:
         else:
             raise MalformedAvro("array/map item capacity did not converge")
 
+        host = self.expand_host(host)
         if host["#red:err"][0]:
             # rare path (malformed batch): re-put the unpacked inputs for
             # the walk-only error pass

@@ -90,17 +90,18 @@ class ShardedDecoder:
     # -- compiled sharded launch ------------------------------------------
 
     def _sharded_fn(self, R: int, B: int, item_caps: Tuple[int, ...],
-                    tot_caps: Tuple[int, ...]):
+                    tot_caps: Tuple[int, ...], compact: bool = True):
         """Jit of ``shard_map(per-chunk pipeline)`` over the mesh, cached
         per (R, B, caps) bucket like the single-device pipeline."""
-        key = (R, B, item_caps, tot_caps)
+        key = (R, B, item_caps, tot_caps, compact)
         hit = self._cache.get(key)
         if hit is not None:
             return hit
         jax = self._jax
         jnp = jax.numpy
         lax = jax.lax
-        pipe, layout = self.base.build_pipeline(R, B, item_caps, tot_caps)
+        pipe, layout = self.base.build_pipeline(R, B, item_caps, tot_caps,
+                                                compact)
         P = jax.sharding.PartitionSpec
         W = B // 4
 
@@ -181,9 +182,16 @@ class ShardedDecoder:
         hosts = None
         for _attempt in range(24):
             item_caps, tot_caps = self.base.caps_snapshot(R)
-            fn, layout = self._sharded_fn(R, B, item_caps, tot_caps)
+            compact = (R, B) not in self.base._str_full
+            fn, layout = self._sharded_fn(R, B, item_caps, tot_caps,
+                                          compact)
             blob = np.asarray(jax.device_get(fn(buf_d)))
             hosts = [split_blob(blob[d], layout) for d in range(D)]
+            if compact and "#red:strfit" in hosts[0] and not all(
+                h["#red:strfit"][0] for h in hosts
+            ):
+                self.base._str_full.add((R, B))
+                continue
             red_max = {}
             red_sum = {}
             for rid, path in enumerate(prog.regions):
@@ -215,6 +223,7 @@ class ShardedDecoder:
 
         out = []
         for d, h in enumerate(hosts):
+            h = self.base.expand_host(h)
             meta = {"item_totals": {}, "flat": flats[d]}
             for rid, path in enumerate(prog.regions):
                 if rid != ROWS:

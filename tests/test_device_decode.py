@@ -11,6 +11,8 @@ import json
 
 import pytest
 
+pytestmark = pytest.mark.slowcompile
+
 import pyruhvro_tpu as pv
 from pyruhvro_tpu.fallback.decoder import MalformedAvro, decode_to_record_batch
 from pyruhvro_tpu.fallback.io import write_long
@@ -258,3 +260,48 @@ def test_huge_block_count_rejected_not_truncated():
     write_long(datum, 1 << 32)  # xs: bogus block count
     with pytest.raises(MalformedAvro):
         get_device_codec(entry).decode([bytes(datum)])
+
+
+def test_compact_string_descriptors_shrink_blob():
+    """The compact-string + bit-packed layout must be materially smaller
+    than the full-width layout (the d2h direction is the expensive one)."""
+    from pyruhvro_tpu.ops.decode import DeviceDecoder
+
+    entry = get_or_parse_schema(KAFKA_SCHEMA_JSON)
+    dec = DeviceDecoder(entry.ir)
+    caps = tuple(0 if r == 0 else 8 for r in range(len(dec.prog.regions)))
+    tots = tuple(0 if r == 0 else 512 for r in range(len(dec.prog.regions)))
+
+    def total(compact):
+        import numpy as np
+
+        _fn, layout = dec.build_pipeline(512, 1 << 16, caps, tots, compact)
+        return sum(np.dtype(dt).itemsize * ln for _k, dt, ln in layout)
+
+    assert total(True) < 0.75 * total(False)
+
+
+def test_long_strings_fall_back_to_full_descriptors():
+    """Strings over the compact len budget trigger the full-width retry
+    (same ladder as capacity growth) and still decode exactly."""
+    schema = ('{"type":"record","name":"S","fields":'
+              '[{"name":"s","type":"string"}]}')
+    entry = get_or_parse_schema(schema)
+    import pyarrow as pa
+
+    from pyruhvro_tpu.fallback.encoder import (
+        compile_encoder_plan,
+        encode_record_batch,
+    )
+
+    vals = ["x" * 5000, "short", "y" * 3000]
+    batch = pa.RecordBatch.from_pydict({"s": pa.array(vals)})
+    datums = [
+        bytes(d)
+        for d in encode_record_batch(
+            batch, entry.ir, compile_encoder_plan(entry.ir)
+        )
+    ]
+    codec = get_device_codec(entry)
+    assert codec.decode(datums).column(0).to_pylist() == vals
+    assert codec.decoder._str_full  # the bucket was remembered as full

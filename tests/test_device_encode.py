@@ -11,6 +11,8 @@ host reader reproduce the original batch.
 import pyarrow as pa
 import pytest
 
+pytestmark = pytest.mark.slowcompile
+
 import pyruhvro_tpu as pv
 from pyruhvro_tpu.fallback.decoder import decode_to_record_batch
 from pyruhvro_tpu.fallback.encoder import encode_record_batch

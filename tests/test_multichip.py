@@ -24,9 +24,13 @@ from pyruhvro_tpu.utils.datagen import (
 
 from test_device_decode import SHAPES
 
-pytestmark = pytest.mark.skipif(
-    len(jax.devices()) < 2, reason="needs the spoofed multi-device mesh"
-)
+pytestmark = [
+    pytest.mark.slowcompile,
+    pytest.mark.skipif(
+        len(jax.devices()) < 2,
+        reason="needs the spoofed multi-device mesh",
+    ),
+]
 
 
 def _sharded_diff(schema: str, datums, n_devices: int) -> None:
