@@ -22,7 +22,6 @@ import pyarrow as pa
 from ..fallback.io import MalformedAvro
 from ..ops.varint import ERR_NAMES
 from ..runtime.native.build import load_host_codec
-from ..runtime.pack import concat_records
 from .program import HostProgram, lower_host
 
 __all__ = ["NativeHostCodec", "native_available"]
@@ -56,11 +55,13 @@ class NativeHostCodec:
         from ..runtime import metrics
 
         n = len(data)
-        with metrics.timer("host.pack_s"):
-            flat, offsets = concat_records(data)
+        # records decode straight from the caller's bytes objects (span
+        # collection in C++, ≙ extract_bytes_list src/lib.rs:29-33) —
+        # no concatenation pass exists on this path at all
         with metrics.timer("host.vm_s"):
             bufs, err_rec, err_bits = self._mod.decode(
-                self.prog.ops, self.prog.coltypes, flat, offsets, n, nthreads
+                self.prog.ops, self.prog.coltypes,
+                data if isinstance(data, list) else list(data), nthreads
             )
         if err_rec >= 0:
             bit = err_bits & -err_bits
@@ -77,7 +78,9 @@ class NativeHostCodec:
             # the VM returns running totals; Arrow offsets lead with 0
             host[k] = np.concatenate([np.zeros(1, np.int32), host[k]])
             item_totals[path] = int(host[k][-1])
-        meta = {"item_totals": item_totals, "flat": flat}
+        # string values travel in-VM (#bytes); the assembler's flat-
+        # buffer gather path is never taken on this backend
+        meta = {"item_totals": item_totals, "flat": np.zeros(0, np.uint8)}
         with metrics.timer("host.build_s"):
             return build_record_batch(
                 self.ir, self.arrow_schema, host, n, meta

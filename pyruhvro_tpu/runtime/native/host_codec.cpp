@@ -312,14 +312,128 @@ class Vm {
   std::vector<Col>* cols_;
 };
 
+// ---- Python list[bytes] span collection (GIL held) -------------------
+
+struct Span {
+  const uint8_t* ptr;
+  Py_ssize_t len;
+};
+
+bool collect_spans(PyObject* seq, std::vector<Span>& spans,
+                   std::vector<Py_buffer>& views,
+                   std::vector<PyObject*>& pins) {
+  Py_ssize_t n = PySequence_Fast_GET_SIZE(seq);
+  spans.reserve((size_t)n);
+  PyObject** items = PySequence_Fast_ITEMS(seq);
+  for (Py_ssize_t i = 0; i < n; i++) {
+    PyObject* item = items[i];
+    if (PyBytes_Check(item)) {
+      // pin the bytes object: the caller's list can be mutated by
+      // another Python thread while the GIL is released below, and the
+      // list is the only thing keeping these borrowed pointers alive
+      Py_INCREF(item);
+      pins.push_back(item);
+      spans.push_back({reinterpret_cast<const uint8_t*>(
+                           PyBytes_AS_STRING(item)),
+                       PyBytes_GET_SIZE(item)});
+    } else {
+      Py_buffer view;  // holds its own reference until released
+      if (PyObject_GetBuffer(item, &view, PyBUF_SIMPLE) != 0) {
+        PyErr_Format(PyExc_TypeError, "record %zd is not bytes-like", i);
+        return false;
+      }
+      views.push_back(view);
+      spans.push_back({static_cast<const uint8_t*>(view.buf), view.len});
+    }
+  }
+  return true;
+}
+
+void release_spans(std::vector<Py_buffer>& views,
+                   std::vector<PyObject*>& pins) {
+  for (auto& v : views) PyBuffer_Release(&v);
+  for (auto* p : pins) Py_DECREF(p);
+}
+
 struct ShardResult {
   std::vector<Col> cols;
   int64_t err_record = -1;
   int32_t err_bits = 0;
 };
 
+// The single place that maps a column builder to its raw output bytes
+// (``which`` selects COL_STR's second buffer, the lens).
+const void* col_data(const Col& col, int32_t ty, int which, size_t* nbytes) {
+  switch (ty) {
+    case COL_I32:
+    case COL_OFFS:
+      *nbytes = col.i32.size() * 4;
+      return col.i32.data();
+    case COL_I64:
+      *nbytes = col.i64.size() * 8;
+      return col.i64.data();
+    case COL_F32:
+      *nbytes = col.f32.size() * 4;
+      return col.f32.data();
+    case COL_F64:
+      *nbytes = col.f64.size() * 8;
+      return col.f64.data();
+    case COL_U8:
+      *nbytes = col.u8.size();
+      return col.u8.data();
+    case COL_STR:
+      if (which == 1) {
+        *nbytes = col.i32.size() * 4;
+        return col.i32.data();
+      }
+      *nbytes = col.u8.size();
+      return col.u8.data();
+  }
+  *nbytes = 0;
+  return nullptr;
+}
+
+// One result buffer for column ``c``: allocated at the summed size and
+// filled per shard — no intermediate merge vectors for any shard count.
+// COL_OFFS running totals rebase during the copy.
+PyObject* build_col_buffer(const std::vector<ShardResult>& shards, size_t c,
+                           int32_t ty, int which) {
+  size_t total = 0, nb = 0;
+  for (auto& s : shards) {
+    col_data(s.cols[c], ty, which, &nb);
+    total += nb;
+  }
+  PyObject* out = PyBytes_FromStringAndSize(nullptr, (Py_ssize_t)total);
+  if (!out) return nullptr;
+  char* dst = PyBytes_AS_STRING(out);
+  int64_t base = 0;
+  for (auto& s : shards) {
+    const Col& col = s.cols[c];
+    const void* src = col_data(col, ty, which, &nb);
+    if (ty == COL_OFFS && base) {
+      const int32_t* sp = static_cast<const int32_t*>(src);
+      int32_t* dp = reinterpret_cast<int32_t*>(dst);
+      for (size_t i = 0; i < nb / 4; i++) {
+        int64_t v = base + (int64_t)sp[i];
+        if (v > INT32_MAX) {
+          Py_DECREF(out);
+          PyErr_SetString(PyExc_OverflowError,
+                          "item total exceeds int32 offsets");
+          return nullptr;
+        }
+        dp[i] = (int32_t)v;
+      }
+    } else if (nb) {
+      std::memcpy(dst, src, nb);
+    }
+    dst += nb;
+    if (ty == COL_OFFS) base += (int64_t)col.running;
+  }
+  return out;
+}
+
 void run_shard(const Op* ops, const int32_t* coltypes, size_t ncols,
-               const uint8_t* flat, const int64_t* offsets, int64_t row_a,
+               const Span* spans, int64_t row_a,
                int64_t row_b, ShardResult* out) {
   out->cols.resize(ncols);
   int64_t nrows = row_b - row_a;
@@ -351,7 +465,7 @@ void run_shard(const Op* ops, const int32_t* coltypes, size_t ncols,
   }
   Vm vm(ops, &out->cols);
   for (int64_t i = row_a; i < row_b; i++) {
-    Reader r{flat, offsets[i], offsets[i + 1], 0};
+    Reader r{spans[i].ptr, 0, spans[i].len, 0};
     vm.exec(0, r, true);
     if (!r.err && r.cur != r.end) r.err |= ERR_TRAILING;
     if (r.err) {
@@ -537,39 +651,42 @@ PyObject* bytes_from(const void* p, size_t nbytes) {
                                    (Py_ssize_t)nbytes);
 }
 
-// decode(ops, coltypes, flat, offsets, n, nthreads)
+// decode(ops, coltypes, data_list, nthreads)
 //   -> (buffers: list[bytes], err_record: int, err_bits: int)
-// Buffer order: for each column in order — COL_STR contributes two
-// entries (start int64, len int32); others one. COL_OFFS buffers carry
-// running totals only; Python prepends the leading 0.
+// ``data_list`` is the caller's list[bytes] — records decode straight
+// from the original Python buffers (span collection under the GIL, like
+// the packer shim), so no host-side concatenation pass or flat copy
+// exists at all. Buffer order: for each column in order — COL_STR
+// contributes two entries (value bytes uint8, len int32); others one.
+// COL_OFFS buffers carry running totals only; Python prepends the 0.
 PyObject* py_decode(PyObject*, PyObject* args) {
-  PyObject *ops_obj, *coltypes_obj, *flat_obj, *offsets_obj;
-  Py_ssize_t n;
+  PyObject *ops_obj, *coltypes_obj, *list_obj;
   int nthreads = 0;
-  if (!PyArg_ParseTuple(args, "OOOOn|i", &ops_obj, &coltypes_obj, &flat_obj,
-                        &offsets_obj, &n, &nthreads))
+  if (!PyArg_ParseTuple(args, "OOO|i", &ops_obj, &coltypes_obj, &list_obj,
+                        &nthreads))
     return nullptr;
 
-  BufferGuard ops_b, ct_b, flat_b, off_b;
-  if (!ops_b.acquire(ops_obj, "ops") || !ct_b.acquire(coltypes_obj, "coltypes") ||
-      !flat_b.acquire(flat_obj, "flat") || !off_b.acquire(offsets_obj, "offsets"))
+  BufferGuard ops_b, ct_b;
+  if (!ops_b.acquire(ops_obj, "ops") || !ct_b.acquire(coltypes_obj, "coltypes"))
     return nullptr;
 
   if (ops_b.view.len % sizeof(Op) != 0) {
     PyErr_SetString(PyExc_ValueError, "ops buffer size not a multiple of op size");
     return nullptr;
   }
-  if (off_b.view.len < (Py_ssize_t)((n + 1) * sizeof(int64_t))) {
-    PyErr_SetString(PyExc_ValueError, "offsets buffer too small");
-    return nullptr;
-  }
   const Op* ops = static_cast<const Op*>(ops_b.view.buf);
   const int32_t* coltypes = static_cast<const int32_t*>(ct_b.view.buf);
   size_t ncols = (size_t)(ct_b.view.len / sizeof(int32_t));
-  const uint8_t* flat = static_cast<const uint8_t*>(flat_b.view.buf);
-  const int64_t* offsets = static_cast<const int64_t*>(off_b.view.buf);
-  if (n > 0 && offsets[n] > flat_b.view.len) {
-    PyErr_SetString(PyExc_ValueError, "offsets overrun the flat buffer");
+
+  PyObject* seq = PySequence_Fast(list_obj, "data must be a sequence");
+  if (!seq) return nullptr;
+  Py_ssize_t n = PySequence_Fast_GET_SIZE(seq);
+  std::vector<Span> spans;
+  std::vector<Py_buffer> views;
+  std::vector<PyObject*> pins;
+  if (!collect_spans(seq, spans, views, pins)) {
+    release_spans(views, pins);
+    Py_DECREF(seq);
     return nullptr;
   }
 
@@ -578,141 +695,48 @@ PyObject* py_decode(PyObject*, PyObject* args) {
 
   Py_BEGIN_ALLOW_THREADS;
   if (nt <= 1) {
-    run_shard(ops, coltypes, ncols, flat, offsets, 0, n, &shards[0]);
+    run_shard(ops, coltypes, ncols, spans.data(), 0, n, &shards[0]);
   } else {
     std::vector<std::thread> threads;
     int64_t per = n / nt;
     for (int t = 0; t < nt; t++) {
       int64_t a = per * t;
       int64_t b = (t == nt - 1) ? n : per * (t + 1);
-      threads.emplace_back(run_shard, ops, coltypes, ncols, flat, offsets, a,
-                           b, &shards[(size_t)t]);
+      threads.emplace_back(run_shard, ops, coltypes, ncols, spans.data(),
+                           a, b, &shards[(size_t)t]);
     }
     for (auto& th : threads) th.join();
   }
   Py_END_ALLOW_THREADS;
+  release_spans(views, pins);
+  Py_DECREF(seq);
 
   for (auto& s : shards)
     if (s.err_record >= 0)
       return Py_BuildValue("(OLi)", Py_None, (long long)s.err_record,
                            (int)s.err_bits);
 
-  // merge shards: plain concatenation, except COL_OFFS running totals
-  // are rebased by the preceding shards' totals
+  // one output buffer per column (two for COL_STR), allocated at the
+  // summed size and filled per shard by build_col_buffer — COL_OFFS
+  // rebases during the copy, every other type is a straight memcpy
   PyObject* bufs = PyList_New(0);
   if (!bufs) return nullptr;
   for (size_t c = 0; c < ncols; c++) {
     int32_t ty = coltypes[c];
-    size_t total_a = 0, total_b = 0;
-    for (auto& s : shards) {
-      const Col& col = s.cols[c];
-      total_a += col.i32.size() + col.u8.size() + col.f32.size();
-      total_b += col.i64.size() + col.f64.size();
-    }
-    PyObject* first = nullptr;
-    PyObject* second = nullptr;
-    switch (ty) {
-      case COL_I32:
-      case COL_OFFS: {
-        std::vector<int32_t> merged;
-        merged.reserve(total_a);
-        int64_t base = 0;
-        for (auto& s : shards) {
-          const Col& col = s.cols[c];
-          if (ty == COL_OFFS && base) {
-            for (int32_t v : col.i32) {
-              int64_t nv = base + (int64_t)v;
-              if (nv > INT32_MAX) {
-                Py_DECREF(bufs);
-                PyErr_SetString(PyExc_OverflowError,
-                                "item total exceeds int32 offsets");
-                return nullptr;
-              }
-              merged.push_back((int32_t)nv);
-            }
-          } else {
-            merged.insert(merged.end(), col.i32.begin(), col.i32.end());
-          }
-          if (ty == COL_OFFS) base += (int64_t)col.running;
-        }
-        first = bytes_from(merged.data(), merged.size() * 4);
-        break;
-      }
-      case COL_I64: {
-        std::vector<int64_t> merged;
-        merged.reserve(total_b);
-        for (auto& s : shards) {
-          const Col& col = s.cols[c];
-          merged.insert(merged.end(), col.i64.begin(), col.i64.end());
-        }
-        first = bytes_from(merged.data(), merged.size() * 8);
-        break;
-      }
-      case COL_F32: {
-        std::vector<float> merged;
-        merged.reserve(total_a);
-        for (auto& s : shards) {
-          const Col& col = s.cols[c];
-          merged.insert(merged.end(), col.f32.begin(), col.f32.end());
-        }
-        first = bytes_from(merged.data(), merged.size() * 4);
-        break;
-      }
-      case COL_F64: {
-        std::vector<double> merged;
-        merged.reserve(total_b);
-        for (auto& s : shards) {
-          const Col& col = s.cols[c];
-          merged.insert(merged.end(), col.f64.begin(), col.f64.end());
-        }
-        first = bytes_from(merged.data(), merged.size() * 8);
-        break;
-      }
-      case COL_U8: {
-        std::vector<uint8_t> merged;
-        merged.reserve(total_a);
-        for (auto& s : shards) {
-          const Col& col = s.cols[c];
-          merged.insert(merged.end(), col.u8.begin(), col.u8.end());
-        }
-        first = bytes_from(merged.data(), merged.size());
-        break;
-      }
-      case COL_STR: {
-        std::vector<uint8_t> bytes;
-        std::vector<int32_t> lens;
-        size_t nb = 0;
-        for (auto& s : shards) nb += s.cols[c].u8.size();
-        bytes.reserve(nb);
-        lens.reserve(total_a);
-        for (auto& s : shards) {
-          const Col& col = s.cols[c];
-          bytes.insert(bytes.end(), col.u8.begin(), col.u8.end());
-          lens.insert(lens.end(), col.i32.begin(), col.i32.end());
-        }
-        first = bytes_from(bytes.data(), bytes.size());
-        second = bytes_from(lens.data(), lens.size() * 4);
-        break;
-      }
-      default:
-        Py_DECREF(bufs);
-        PyErr_Format(PyExc_ValueError, "unknown column type %d", (int)ty);
-        return nullptr;
-    }
-    if (!first || PyList_Append(bufs, first) != 0) {
-      Py_XDECREF(first);
-      Py_XDECREF(second);
+    if (ty < 0 || ty > COL_OFFS) {
       Py_DECREF(bufs);
+      PyErr_Format(PyExc_ValueError, "unknown column type %d", (int)ty);
       return nullptr;
     }
-    Py_DECREF(first);
-    if (second) {
-      if (PyList_Append(bufs, second) != 0) {
-        Py_DECREF(second);
+    int nparts = ty == COL_STR ? 2 : 1;
+    for (int which = 0; which < nparts; which++) {
+      PyObject* b = build_col_buffer(shards, c, ty, which);
+      if (!b || PyList_Append(bufs, b) != 0) {
+        Py_XDECREF(b);
         Py_DECREF(bufs);
         return nullptr;
       }
-      Py_DECREF(second);
+      Py_DECREF(b);
     }
   }
   PyObject* out = Py_BuildValue("(OLi)", bufs, (long long)-1, 0);
