@@ -384,10 +384,40 @@ def main() -> None:
         _bench_fastavro(kafka, datums, args.reps, details)
     except ImportError:
         _log("[bench] fastavro not installed; comparison sweep skipped")
+        # stand-in reference point: this package's own pure-Python
+        # decoder plays fastavro's role (a per-record interpreted wire
+        # walk); the reference's measured fastavro rate was 247k rec/s
+        # on an M-series core (README.md:32-33) — see BENCH_NOTES.md
+        _bench_pyfallback(kafka, datums, max(2, args.reps - 2), details)
     save_details()
     # ... and the driver reads the LAST stdout line: print it (again)
     # as the final act (VERDICT r03: BENCH_r03.json parsed=null)
     print(_headline_line(), flush=True)
+
+
+def _bench_pyfallback(schema, datums, reps, details):
+    """Pure-Python fallback decoder on the headline workload — the
+    interpreted-per-record comparison row when fastavro is absent."""
+    from pyruhvro_tpu.fallback.decoder import compile_reader, decode_to_record_batch
+    from pyruhvro_tpu.schema.cache import get_or_parse_schema
+
+    e = get_or_parse_schema(schema)
+    reader = compile_reader(e.ir)
+    data = datums[: min(len(datums), 10_000)]
+
+    def run():
+        return decode_to_record_batch(data, e.ir, e.arrow_schema, reader)
+
+    dt = _time_best(run, reps)
+    rec_s = len(data) / dt
+    _log(f"[bench] pyfallback deserialize {len(data)} rows: "
+         f"{dt * 1e3:.3f} ms = {rec_s:,.0f} rec/s")
+    details["results"].append({
+        "op": "deserialize", "backend": "pyfallback", "rows": len(data),
+        "chunks": 1, "schema": "kafka", "seconds": dt,
+        "records_per_s": rec_s,
+        "vs_baseline": rec_s / BASELINE_DECODE_REC_S,
+    })
 
 
 def _bench_fastavro(schema, datums, reps, details):

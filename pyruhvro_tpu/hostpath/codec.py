@@ -60,8 +60,7 @@ class NativeHostCodec:
         # no concatenation pass exists on this path at all
         with metrics.timer("host.vm_s"):
             bufs, err_rec, err_bits = self._mod.decode(
-                self.prog.ops, self.prog.coltypes,
-                data if isinstance(data, list) else list(data), nthreads
+                self.prog.ops, self.prog.coltypes, data, nthreads
             )
         if err_rec >= 0:
             bit = err_bits & -err_bits
