@@ -16,13 +16,14 @@ from .schema.model import (
     Array,
     AvroType,
     Enum,
+    Fixed,
     Map,
     Primitive,
     Record,
     Union,
 )
 
-__all__ = ["is_supported"]
+__all__ = ["is_supported", "host_supported"]
 
 _SUPPORTED_LOGICAL = {
     None: ("null", "boolean", "int", "long", "float", "double", "string"),
@@ -31,24 +32,53 @@ _SUPPORTED_LOGICAL = {
     "timestamp-micros": ("long",),
 }
 
+# The native host VM covers more than the device subset: bytes, fixed
+# (incl. duration), and the remaining integer-wire logical types. Still
+# excluded (served by the Python fallback): decimal (oracle semantics
+# are decimal-context arithmetic) and uuid (oracle accepts every text
+# form the stdlib UUID parser does).
+_HOST_EXTRA_LOGICAL = {
+    None: ("bytes",),
+    "time-millis": ("int",),
+    "time-micros": ("long",),
+    "local-timestamp-millis": ("long",),
+    "local-timestamp-micros": ("long",),
+}
 
-def _inner(t: AvroType) -> bool:
+
+def _inner(t: AvroType, extra=None) -> bool:
     if isinstance(t, Primitive):
         allowed = _SUPPORTED_LOGICAL.get(t.logical)
-        return allowed is not None and t.name in allowed
+        if allowed is not None and t.name in allowed:
+            return True
+        if extra is not None:
+            allowed = extra.get(t.logical)
+            return allowed is not None and t.name in allowed
+        return False
     if isinstance(t, Enum):
         return True
     if isinstance(t, Record):
-        return all(_inner(f.type) for f in t.fields)
+        return all(_inner(f.type, extra) for f in t.fields)
     if isinstance(t, Union):
-        return all(_inner(v) for v in t.variants)
+        return all(_inner(v, extra) for v in t.variants)
     if isinstance(t, Array):
-        return _inner(t.items)
+        return _inner(t.items, extra)
     if isinstance(t, Map):
-        return _inner(t.values)
-    return False  # Fixed (incl. decimal/duration), unknown
+        return _inner(t.values, extra)
+    if extra is not None and isinstance(t, Fixed):
+        return t.logical in (None, "duration")
+    return False  # device path: Fixed (incl. decimal/duration), unknown
 
 
 def is_supported(t: AvroType) -> bool:
-    """True if the TPU fast path can handle this top-level schema."""
+    """True if the TPU fast path can handle this top-level schema
+    (= the reference's fast subset, ``fast_decode.rs:38-61``)."""
     return isinstance(t, Record) and _inner(t)
+
+
+def host_supported(t: AvroType) -> bool:
+    """True if the native host VM can handle this top-level schema —
+    the fast subset plus bytes / fixed / duration / time-* /
+    local-timestamp-* (beyond the reference's fast subset; its fallback
+    serves these at Value-tree speed, ``complex.rs``)."""
+    return isinstance(t, Record) and _inner(t, _HOST_EXTRA_LOGICAL)

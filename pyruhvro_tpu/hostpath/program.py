@@ -22,11 +22,12 @@ from typing import Dict, List, Tuple
 import numpy as np
 
 from ..ops import UnsupportedOnDevice
-from ..gate import is_supported
+from ..gate import host_supported
 from ..schema.model import (
     Array,
     AvroType,
     Enum,
+    Fixed,
     Map,
     Primitive,
     Record,
@@ -38,7 +39,7 @@ __all__ = ["HostProgram", "lower_host", "COL_NBUF"]
 # op kinds (≙ host_codec.cpp OpKind)
 OP_RECORD, OP_INT, OP_LONG, OP_FLOAT, OP_DOUBLE, OP_BOOL = 0, 1, 2, 3, 4, 5
 OP_STRING, OP_ENUM, OP_NULL, OP_NULLABLE, OP_UNION = 6, 7, 8, 9, 10
-OP_ARRAY, OP_MAP = 11, 12
+OP_ARRAY, OP_MAP, OP_FIXED = 11, 12, 13
 
 # column types (≙ host_codec.cpp ColType)
 COL_I32, COL_I64, COL_F32, COL_F64, COL_U8, COL_STR, COL_OFFS = range(7)
@@ -128,8 +129,15 @@ class _HostLowering:
                 self.emit(OP_BOOL, col=self.col(path + "#v", COL_U8, region))
             elif name == "string":
                 self.emit(OP_STRING, col=self.col(path, COL_STR, region))
-            else:  # pragma: no cover — gated by is_supported
+            elif name == "bytes":
+                # same wire form and builder as string; only the Arrow
+                # assembly differs (Binary, no UTF-8 check)
+                self.emit(OP_STRING, col=self.col(path, COL_STR, region))
+            else:  # pragma: no cover — gated by host_supported
                 raise UnsupportedOnDevice(f"primitive {name!r} at {path!r}")
+        elif isinstance(t, Fixed):
+            self.emit(OP_FIXED, a=t.size,
+                      col=self.col(path + "#fix", COL_U8, region))
         elif isinstance(t, Enum):
             self.emit(OP_ENUM, a=len(t.symbols),
                       col=self.col(path + "#v", COL_I32, region))
@@ -176,9 +184,11 @@ class _HostLowering:
 
 
 def lower_host(ir: AvroType) -> HostProgram:
-    """Lower a top-level record schema to its host bytecode program."""
-    if not is_supported(ir):
-        raise UnsupportedOnDevice("schema is outside the fast-path subset")
+    """Lower a top-level record schema to its host bytecode program
+    (gate: :func:`..gate.host_supported` — the fast subset plus
+    bytes/fixed/duration/time-*/local-timestamp-*)."""
+    if not host_supported(ir):
+        raise UnsupportedOnDevice("schema is outside the host VM subset")
     lo = _HostLowering()
     lo.lower_type(ir, "", 0)
     n = len(lo.ops)

@@ -28,6 +28,7 @@ from ..schema.model import (
     Array,
     AvroType,
     Enum,
+    Fixed,
     Map,
     Primitive,
     Record,
@@ -119,6 +120,8 @@ class _Assembler:
 
         if isinstance(t, Primitive):
             return self._primitive(t, dt, path, count, parent_valid)
+        if isinstance(t, Fixed):
+            return self._fixed(t, dt, path, count, parent_valid)
         if isinstance(t, Enum):
             return self._enum(t, path, count, parent_valid)
         if isinstance(t, Record):
@@ -153,6 +156,18 @@ class _Assembler:
                 ) + np.arange(total, dtype=np.int64)
                 values = self.flat[src]
             _check_utf8(values, voff, path)
+            return pa.Array.from_buffers(
+                dt, count,
+                [vbuf, pa.py_buffer(voff), pa.py_buffer(values)],
+                null_count=nulls,
+            )
+        if name == "bytes":
+            # same buffer layout as string (the host VM emits #bytes/#len
+            # for both); Binary type, no UTF-8 check
+            lens = self.host[path + "#len"][:count]
+            voff = np.zeros(count + 1, np.int32)
+            np.cumsum(lens, out=voff[1:])
+            values = self.host[path + "#bytes"][: int(voff[count])]
             return pa.Array.from_buffers(
                 dt, count,
                 [vbuf, pa.py_buffer(voff), pa.py_buffer(values)],
@@ -202,6 +217,37 @@ class _Assembler:
                 dt, count, [vbuf, pa.py_buffer(arr)], null_count=nulls
             )
         raise NotImplementedError(name)
+
+    def _fixed(self, t, dt, path, count, valid):
+        """Avro ``fixed`` from the host VM's raw #fix byte column;
+        ``duration`` converts fixed(12) (months, days, ms u32-LE) to
+        Duration(ms) with the oracle's 30-day-month convention
+        (``fallback/decoder.py``)."""
+        vbuf, nulls = _validity(valid, count)
+        raw = self.host[path + "#fix"][: count * t.size]
+        if t.logical == "duration":
+            u = np.ascontiguousarray(raw).view(np.uint32).reshape(count, 3)
+            # uint64 holds the wire maximum ((2^32·30 + 2^32)·86400000 +
+            # 2^32 < 2^64); values past int64 overflow Duration(ms) like
+            # the oracle's pa.array does
+            ms = (
+                (u[:, 0].astype(np.uint64) * 30 + u[:, 1]) * 86_400_000
+                + u[:, 2]
+            )
+            if bool((ms > np.uint64(np.iinfo(np.int64).max)).any()):
+                raise OverflowError(
+                    f"duration at {path!r} exceeds Duration(ms) int64"
+                )
+            return pa.Array.from_buffers(
+                dt, count,
+                [vbuf, pa.py_buffer(ms.astype(np.int64))],
+                null_count=nulls,
+            )
+        return pa.Array.from_buffers(
+            dt, count,
+            [vbuf, pa.py_buffer(np.ascontiguousarray(raw))],
+            null_count=nulls,
+        )
 
     def _enum(self, t, path, count, valid):
         """Enum indices → Utf8 through the symbol table, vectorized."""

@@ -49,6 +49,7 @@ from ..schema.model import (
     Array,
     AvroType,
     Enum,
+    Fixed,
     Map,
     Primitive,
     Record,
@@ -633,7 +634,53 @@ class _Extractor:
         if isinstance(t, (Array, Map)):
             self._extract_repeated(t, arr, path, region, parent)
             return
+        if isinstance(t, Fixed):
+            self._extract_fixed(t, arr, path, region)
+            return
         raise UnsupportedOnDevice(f"type {type(t).__name__} at {path!r}")
+
+    def _extract_fixed(self, t, arr, path, region) -> None:
+        """Avro ``fixed`` → one raw byte run (size per entry); a
+        ``duration`` Arrow input (Duration(ms) int64) converts back to
+        the wire's (months, days, ms) u32-LE triple with the oracle's
+        divmod arithmetic (``fallback/encoder.py``)."""
+        n = len(arr)
+        size = t.size
+        if t.logical == "duration":
+            import pyarrow.compute as pc
+
+            ms = (
+                pc.fill_null(arr.cast(pa.int64()), 0)
+                .to_numpy(zero_copy_only=False)
+                .astype(np.int64)
+            )
+            days_total, ms_r = np.divmod(ms, 86_400_000)
+            months, days = np.divmod(days_total, 30)
+            for name, v in (("months", months), ("days", days),
+                            ("ms", ms_r)):
+                bad = (v < 0) | (v >= (1 << 32))
+                if bad.any():
+                    raise ValueError(
+                        f"duration {name} component out of uint32 range "
+                        f"at row {int(np.flatnonzero(bad)[0])}"
+                    )
+            raw = np.ascontiguousarray(
+                np.stack(
+                    [months.astype(np.uint32), days.astype(np.uint32),
+                     ms_r.astype(np.uint32)],
+                    axis=1,
+                )
+            ).view(np.uint8).reshape(-1)
+        else:
+            buf = arr.buffers()[1]
+            if buf is None:
+                raw = np.zeros(n * size, np.uint8)
+            else:
+                raw = np.frombuffer(
+                    buf, np.uint8, count=(arr.offset + n) * size
+                )[arr.offset * size:]
+        self.put(path + "#fix", raw, region)
+        self.bound += size * n
 
     def _extract_primitive(self, t: Primitive, arr, path, region) -> None:
         name = t.name
@@ -673,6 +720,9 @@ class _Extractor:
             self.put(path + "#v", self._ints(arr, pa.uint8(), np.uint8), region)
             self.bound += len(arr)
         elif name == "string":
+            self._extract_string(arr, path, region)
+        elif name == "bytes":
+            # Binary shares Utf8's offsets+data layout
             self._extract_string(arr, path, region)
         else:
             raise UnsupportedOnDevice(f"primitive {name!r} at {path!r}")

@@ -50,6 +50,7 @@ enum OpKind : int32_t {
   OP_UNION = 10,
   OP_ARRAY = 11,
   OP_MAP = 12,
+  OP_FIXED = 13,  // a = byte size; col = raw bytes (size per entry)
 };
 
 // ---- column types (keep in sync with hostpath/program.py) ------------
@@ -184,6 +185,18 @@ class Vm {
       }
       case OP_STRING: {
         read_string((*cols_)[op.col], r, present);
+        return pc + 1;
+      }
+      case OP_FIXED: {
+        Col& c = (*cols_)[op.col];
+        int64_t nsz = op.a;
+        if (present && nsz <= r.end - r.cur) {
+          c.u8.insert(c.u8.end(), r.base + r.cur, r.base + r.cur + nsz);
+          r.cur += nsz;
+        } else {
+          if (present) r.err |= ERR_OVERRUN;
+          c.u8.insert(c.u8.end(), (size_t)nsz, 0);  // keep lengths aligned
+        }
         return pc + 1;
       }
       case OP_ENUM: {
@@ -565,6 +578,14 @@ class EncVm {
       }
       case OP_STRING: {
         write_string((*cols_)[op.col], present);
+        return pc + 1;
+      }
+      case OP_FIXED: {
+        InCol& c = (*cols_)[op.col];
+        size_t nsz = (size_t)op.a;
+        if (present)
+          out_->insert(out_->end(), c.u8 + c.cur, c.u8 + c.cur + nsz);
+        c.cur += nsz;
         return pc + 1;
       }
       case OP_NULL:

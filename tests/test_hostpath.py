@@ -182,6 +182,100 @@ def test_api_serialize_host_routes_through_vm():
     assert [bytes(x) for a in out for x in a] == [bytes(d) for d in datums]
 
 
+EXTENDED_SCHEMA = """{"type":"record","name":"X","fields":[
+  {"name":"b","type":"bytes"},
+  {"name":"nb","type":["null","bytes"]},
+  {"name":"f8","type":{"type":"fixed","name":"F8","size":8}},
+  {"name":"dur","type":{"type":"fixed","name":"Dur","size":12,
+      "logicalType":"duration"}},
+  {"name":"tm","type":{"type":"int","logicalType":"time-millis"}},
+  {"name":"tu","type":{"type":"long","logicalType":"time-micros"}},
+  {"name":"lts","type":{"type":"long",
+      "logicalType":"local-timestamp-micros"}},
+  {"name":"ab","type":{"type":"array","items":"bytes"}}]}"""
+
+
+def _extended_datums(n=200):
+    import random
+
+    from pyruhvro_tpu.fallback.encoder import (
+        compile_encoder_plan,
+        encode_record_batch,
+    )
+
+    e = get_or_parse_schema(EXTENDED_SCHEMA)
+    rng = random.Random(5)
+    rows = [
+        {
+            "b": rng.randbytes(rng.randrange(0, 20)),
+            "nb": None if rng.random() < 0.3 else rng.randbytes(5),
+            "f8": rng.randbytes(8),
+            "dur": rng.randrange(0, 10**12),
+            "tm": rng.randrange(0, 86_400_000),
+            "tu": rng.randrange(0, 86_400_000_000),
+            "lts": rng.randrange(0, 2**50),
+            "ab": [rng.randbytes(rng.randrange(0, 6))
+                   for _ in range(rng.randrange(0, 4))],
+        }
+        for _ in range(n)
+    ]
+    batch = pa.RecordBatch.from_pylist(rows, schema=e.arrow_schema)
+    return e, [
+        bytes(d)
+        for d in encode_record_batch(batch, e.ir, compile_encoder_plan(e.ir))
+    ]
+
+
+def test_extended_subset_beyond_reference():
+    """bytes / fixed / duration / time-* / local-timestamp-* run through
+    the VM (the reference serves these only via its slow Value-tree
+    fallback, complex.rs) — decode equals the oracle, encode is
+    wire-exact."""
+    e, datums = _extended_datums()
+    c = NativeHostCodec(e.ir, e.arrow_schema)
+    want = decode_to_record_batch(datums, e.ir, e.arrow_schema)
+    assert c.decode(datums).equals(want)
+    assert [bytes(x) for x in c.encode(want)] == datums
+
+
+def test_extended_subset_served_by_api_auto():
+    from pyruhvro_tpu import metrics
+    from pyruhvro_tpu.api import deserialize_array
+
+    e, datums = _extended_datums(30)
+    metrics.reset()
+    got = deserialize_array(datums, EXTENDED_SCHEMA)  # auto
+    assert metrics.snapshot().get("host.vm_s", 0) > 0
+    assert got.equals(decode_to_record_batch(datums, e.ir, e.arrow_schema))
+
+
+def test_decimal_and_uuid_stay_on_python_fallback():
+    from pyruhvro_tpu.gate import host_supported
+
+    dec = get_or_parse_schema(
+        '{"type":"record","name":"D","fields":[{"name":"d","type":'
+        '{"type":"bytes","logicalType":"decimal","precision":10,'
+        '"scale":2}}]}'
+    )
+    uu = get_or_parse_schema(
+        '{"type":"record","name":"U","fields":[{"name":"u","type":'
+        '{"type":"string","logicalType":"uuid"}}]}'
+    )
+    assert not host_supported(dec.ir)
+    assert not host_supported(uu.ir)
+
+
+def test_truncated_fixed_raises():
+    """Truncation INSIDE the fixed field itself (a one-field schema, so
+    the cut provably lands in OP_FIXED's overrun branch)."""
+    schema = ('{"type":"record","name":"OF","fields":[{"name":"f","type":'
+              '{"type":"fixed","name":"F8","size":8}}]}')
+    e, c = _codec(schema)
+    assert c.decode([b"\x01" * 8]).num_rows == 1
+    with pytest.raises(MalformedAvro, match="past end"):
+        c.decode([b"\x01\x02\x03"])  # 3 of 8 fixed bytes present
+
+
 def test_deep_nesting_and_unions():
     """Nested repetition + sparse unions through the VM vs oracle."""
     schema = """
