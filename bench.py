@@ -227,9 +227,13 @@ def main() -> None:
                                                10_000_000)),
                     help="north-star row count (BASELINE.md: 10M rows; "
                          "0 = skip)")
+    # healthy tunnel bring-up measures < 60 s (BENCH_DETAILS r03: 0.09 s);
+    # a wedged transport never returns, so waiting longer only burns the
+    # bench budget before the host phases run (r04: observed a tunnel
+    # wedge lasting hours)
     ap.add_argument("--probe-timeout", type=float,
                     default=float(os.environ.get(
-                        "PYRUHVRO_TPU_PROBE_TIMEOUT", 900)))
+                        "PYRUHVRO_TPU_PROBE_TIMEOUT", 300)))
     ap.add_argument("--matrix", action="store_true", default=True)
     ap.add_argument("--no-matrix", dest="matrix", action="store_false",
                     help="skip the criterion shape matrix + chunk sweep")

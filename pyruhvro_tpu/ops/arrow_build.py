@@ -137,11 +137,18 @@ class _Assembler:
         name = t.name
         if name == "null":
             return pa.nulls(count, pa.null())
-        if name == "string":
+        if name in ("string", "bytes"):
             lens = self.host[path + "#len"][:count]
+            total = int(lens.sum(dtype=np.int64))
+            if total >= (1 << 31):
+                # int32 offsets would wrap; the oracle's pa.array raises
+                # the same error class here
+                raise pa.lib.ArrowCapacityError(
+                    f"column {path!r} carries {total} value bytes — over "
+                    f"the 2 GiB Binary/Utf8 capacity; split the batch"
+                )
             voff = np.zeros(count + 1, np.int32)
             np.cumsum(lens, out=voff[1:])
-            total = int(voff[count])
             if path + "#bytes" in self.host:
                 # the native host VM copies value bytes contiguously
                 # during its walk; use them directly
@@ -155,19 +162,8 @@ class _Assembler:
                     starts.astype(np.int64) - voff[:-1], lens
                 ) + np.arange(total, dtype=np.int64)
                 values = self.flat[src]
-            _check_utf8(values, voff, path)
-            return pa.Array.from_buffers(
-                dt, count,
-                [vbuf, pa.py_buffer(voff), pa.py_buffer(values)],
-                null_count=nulls,
-            )
-        if name == "bytes":
-            # same buffer layout as string (the host VM emits #bytes/#len
-            # for both); Binary type, no UTF-8 check
-            lens = self.host[path + "#len"][:count]
-            voff = np.zeros(count + 1, np.int32)
-            np.cumsum(lens, out=voff[1:])
-            values = self.host[path + "#bytes"][: int(voff[count])]
+            if name == "string":
+                _check_utf8(values, voff, path)
             return pa.Array.from_buffers(
                 dt, count,
                 [vbuf, pa.py_buffer(voff), pa.py_buffer(values)],
