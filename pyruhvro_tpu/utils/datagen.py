@@ -221,3 +221,76 @@ def kafka_style_datums(n: int, seed: int = 0) -> List[bytes]:
         writer(buf, rec)
         out.append(bytes(buf))
     return out
+
+# ---------------------------------------------------------------------------
+# Random schema generation (differential-fuzz harness)
+# ---------------------------------------------------------------------------
+
+def random_schema(seed: int, max_depth: int = 3) -> str:
+    """A random record schema drawn from the native host subset
+    (SURVEY.md §4's differential strategy, extended from fixed shapes to
+    generated ones). Respects Avro's union rules: no nested unions, at
+    most one variant per unnamed kind. ``duration`` is excluded — its
+    random 12-byte fixeds overflow the oracle's Duration(ms) int64 by
+    construction (covered by targeted tests instead)."""
+    import json as _json
+
+    rng = random.Random(seed)
+    counter = [0]
+
+    def fresh(prefix):
+        counter[0] += 1
+        return f"{prefix}{counter[0]}"
+
+    LEAVES = [
+        "string", "bytes", "int", "long", "float", "double", "boolean",
+        {"type": "int", "logicalType": "date"},
+        {"type": "long", "logicalType": "timestamp-millis"},
+        {"type": "long", "logicalType": "timestamp-micros"},
+        {"type": "int", "logicalType": "time-millis"},
+        {"type": "long", "logicalType": "time-micros"},
+        {"type": "long", "logicalType": "local-timestamp-millis"},
+        {"type": "long", "logicalType": "local-timestamp-micros"},
+    ]
+
+    def gen_type(depth, allow_union=True):
+        roll = rng.random()
+        if depth >= max_depth or roll < 0.45:
+            leaf = rng.choice(LEAVES + [None, None])  # None → named leaf
+            if leaf is not None:
+                return leaf
+            if rng.random() < 0.5:
+                return {"type": "enum", "name": fresh("E"),
+                        "symbols": ["A", "B", "C", "D"][: rng.randint(2, 4)]}
+            return {"type": "fixed", "name": fresh("F"),
+                    "size": rng.randint(1, 16)}
+        if roll < 0.60:
+            return {"type": "array", "items": gen_type(depth + 1)}
+        if roll < 0.72:
+            return {"type": "map", "values": gen_type(depth + 1)}
+        if roll < 0.84:
+            return {"type": "record", "name": fresh("R"), "fields": [
+                {"name": f"f{i}", "type": gen_type(depth + 1)}
+                for i in range(rng.randint(1, 3))
+            ]}
+        if allow_union:
+            if rng.random() < 0.6:  # nullable pair
+                inner = gen_type(depth + 1, allow_union=False)
+                pair = ["null", inner]
+                rng.shuffle(pair)
+                return pair
+            # sparse union: distinct kinds only
+            kinds = rng.sample(
+                ["null", "string", "long", "boolean", "double"],
+                rng.randint(2, 4),
+            )
+            return kinds
+        return rng.choice(["string", "long", "double"])
+
+    fields = [
+        {"name": f"c{i}", "type": gen_type(0)}
+        for i in range(rng.randint(1, 6))
+    ]
+    return _json.dumps(
+        {"type": "record", "name": f"Fuzz{seed}", "fields": fields}
+    )

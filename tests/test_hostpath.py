@@ -192,6 +192,8 @@ EXTENDED_SCHEMA = """{"type":"record","name":"X","fields":[
   {"name":"tu","type":{"type":"long","logicalType":"time-micros"}},
   {"name":"lts","type":{"type":"long",
       "logicalType":"local-timestamp-micros"}},
+  {"name":"ltm","type":{"type":"long",
+      "logicalType":"local-timestamp-millis"}},
   {"name":"ab","type":{"type":"array","items":"bytes"}}]}"""
 
 
@@ -214,6 +216,7 @@ def _extended_datums(n=200):
             "tm": rng.randrange(0, 86_400_000),
             "tu": rng.randrange(0, 86_400_000_000),
             "lts": rng.randrange(0, 2**50),
+            "ltm": rng.randrange(0, 2**50),
             "ab": [rng.randbytes(rng.randrange(0, 6))
                    for _ in range(rng.randrange(0, 4))],
         }
