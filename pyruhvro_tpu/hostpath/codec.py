@@ -141,11 +141,22 @@ class NativeHostCodec:
         with metrics.timer("host.extract_s"):
             ex = run_extractor(self.ir, batch)
             bufs = self._encode_buffers(ex)
+        # pre-size the output vector; the extractor's bound is loose
+        # (10 B/long regardless of varint width), so clamp the eager
+        # allocation — past the clamp, geometric growth takes over
+        hint = min(ex.bound, 64 << 20)
         try:
             with metrics.timer("host.encode_vm_s"):
-                blob, sizes = self._mod.encode(
-                    self.prog.ops, self.prog.coltypes, bufs, n
-                )
+                try:
+                    blob, sizes = self._mod.encode(
+                        self.prog.ops, self.prog.coltypes, bufs, n, hint
+                    )
+                except TypeError:
+                    # stale pre-hint .so (build.py keeps a usable old
+                    # binary when rebuild fails): 4-arg form
+                    blob, sizes = self._mod.encode(
+                        self.prog.ops, self.prog.coltypes, bufs, n
+                    )
         except OverflowError:
             raise BatchTooLarge(n, -1)
         sizes = np.frombuffer(sizes, np.int32)

@@ -30,6 +30,7 @@
 
 #include <cstdint>
 #include <cstring>
+#include <new>
 #include <thread>
 #include <vector>
 
@@ -765,14 +766,21 @@ PyObject* py_decode(PyObject*, PyObject* args) {
   return out;
 }
 
-// encode(ops, coltypes, buffers: list, n) -> (blob: bytes, sizes: bytes)
+// encode(ops, coltypes, buffers: list, n, size_hint=0)
+//   -> (blob: bytes, sizes: bytes)
 // ``buffers`` follows the decode buffer order (COL_STR: bytes then
-// lens). Raises OverflowError when the wire total exceeds int32 offsets
-// (callers split the batch).
+// lens); ``size_hint`` (the extractor's byte bound) pre-sizes the
+// output vector so the hot loop never reallocates. Raises
+// OverflowError when the wire total exceeds int32 offsets (callers
+// split the batch). Single-threaded by design for now: row-sharding
+// encode needs per-region start cursors (cascaded prefix sums of the
+// counts columns) — worth adding on multi-core hosts.
 PyObject* py_encode(PyObject*, PyObject* args) {
   PyObject *ops_obj, *coltypes_obj, *bufs_obj;
   Py_ssize_t n;
-  if (!PyArg_ParseTuple(args, "OOOn", &ops_obj, &coltypes_obj, &bufs_obj, &n))
+  Py_ssize_t size_hint = 0;
+  if (!PyArg_ParseTuple(args, "OOOn|n", &ops_obj, &coltypes_obj, &bufs_obj,
+                        &n, &size_hint))
     return nullptr;
   BufferGuard ops_b, ct_b;
   if (!ops_b.acquire(ops_obj, "ops") || !ct_b.acquire(coltypes_obj, "coltypes"))
@@ -834,7 +842,11 @@ PyObject* py_encode(PyObject*, PyObject* args) {
   std::vector<int32_t> sizes((size_t)n);
   bool overflow = false;
   Py_BEGIN_ALLOW_THREADS;
-  out.reserve((size_t)n * 32);
+  try {
+    out.reserve(size_hint > 0 ? (size_t)size_hint : (size_t)n * 32);
+  } catch (const std::bad_alloc&) {
+    // the hint is advisory; fall back to geometric growth
+  }
   EncVm vm(ops, &cols, &out);
   size_t prev = 0;
   for (Py_ssize_t i = 0; i < n; i++) {
@@ -872,7 +884,8 @@ PyMethodDef methods[] = {
      "decode(ops, coltypes, flat, offsets, n, nthreads=0) -> "
      "(buffers | None, err_record, err_bits)"},
     {"encode", py_encode, METH_VARARGS,
-     "encode(ops, coltypes, buffers, n) -> (blob, sizes_int32)"},
+     "encode(ops, coltypes, buffers, n, size_hint=0) -> "
+     "(blob, sizes_int32)"},
     {nullptr, nullptr, 0, nullptr},
 };
 
