@@ -883,6 +883,37 @@ def extract_batch(prog: EncProgram, batch: pa.RecordBatch,
 
 
 # ---------------------------------------------------------------------------
+# packed-input protocol (shared by the single-device and sharded paths)
+# ---------------------------------------------------------------------------
+
+def input_entries(dv: Dict[str, np.ndarray], axis: int = 0) -> tuple:
+    """The static packed-buffer layout: sorted (key, dtype, length)
+    per input array (``axis`` selects the per-shard length axis for
+    ``[D, ...]``-stacked inputs). The single source of input ordering
+    for :func:`unpack_input_entries` and both packers."""
+    return tuple(
+        sorted((k, str(v.dtype), v.shape[axis]) for k, v in dv.items())
+    )
+
+
+def unpack_input_entries(jnp, lax, buf, entries: tuple) -> Dict[str, object]:
+    """Traced inverse of the packers: split one uint8 buffer back into
+    the input dict by the static ``entries`` layout."""
+    dv = {}
+    pos = 0
+    for k, dt, ln in entries:
+        nb = np.dtype(dt).itemsize * ln
+        seg = buf[pos : pos + nb]
+        if dt != "uint8":
+            seg = lax.bitcast_convert_type(
+                seg.reshape(ln, np.dtype(dt).itemsize), jnp.dtype(dt)
+            )
+        dv[k] = seg
+        pos += nb
+    return dv
+
+
+# ---------------------------------------------------------------------------
 # the encoder object
 # ---------------------------------------------------------------------------
 
@@ -936,18 +967,7 @@ class DeviceEncoder:
         lax = self._jax.lax
 
         def run_packed(buf):
-            dv = {}
-            pos = 0
-            for k, dt, ln in entries:
-                nb = np.dtype(dt).itemsize * ln
-                seg = buf[pos : pos + nb]
-                if dt != "uint8":
-                    seg = lax.bitcast_convert_type(
-                        seg.reshape(ln, np.dtype(dt).itemsize), jnp.dtype(dt)
-                    )
-                dv[k] = seg
-                pos += nb
-            return run(dv, cap)
+            return run(unpack_input_entries(jnp, lax, buf, entries), cap)
 
         fn = self._jax.jit(run_packed)
         self._packed_cache[key] = fn
@@ -974,9 +994,7 @@ class DeviceEncoder:
             raise BatchTooLarge(n, bound)
         cap = bucket_len(bound, minimum=64)
         jax = self._jax
-        entries = tuple(
-            sorted((k, str(v.dtype), v.shape[0]) for k, v in dv.items())
-        )
+        entries = input_entries(dv)
         fresh = (entries, cap) not in self._packed_cache
         packed = np.concatenate(
             [dv[k].view(np.uint8) for k, _dt, _ln in entries]

@@ -19,7 +19,13 @@ from typing import Dict, List, Optional
 import numpy as np
 import pyarrow as pa
 
-from ..ops.encode import _BIG, DeviceEncoder, extract_batch
+from ..ops.encode import (
+    _BIG,
+    DeviceEncoder,
+    extract_batch,
+    input_entries,
+    unpack_input_entries,
+)
 from ..runtime.chunking import chunk_bounds
 from ..runtime.pack import bucket_len
 from .sharded import _shard_map, chunk_mesh
@@ -50,20 +56,26 @@ class ShardedEncoder:
         self._cache: Dict[tuple, object] = {}
         self._lock = threading.Lock()
 
-    def _sharded_fn(self, shape_key, cap: int):
-        """Jit of ``shard_map(per-chunk encode)``, cached per (shapes,
-        cap) bucket like the single-device encoder's jit cache."""
-        key = (shape_key, cap)
+    def _sharded_fn(self, entries: tuple, cap: int):
+        """Jit of ``shard_map(per-chunk encode)`` over ONE packed
+        ``[D, bytes]`` input buffer (a dict input would be one transfer
+        per leaf per shard; layout shared with the single-device path
+        via ``ops.encode.input_entries``/``unpack_input_entries``),
+        cached per (entries, cap) bucket like the single-device
+        encoder's jit cache."""
+        key = (entries, cap)
         hit = self._cache.get(key)
         if hit is not None:
             return hit
         jax = self._jax
+        jnp = jax.numpy
+        lax = jax.lax
         run = self.base._program()
         P = jax.sharding.PartitionSpec
 
-        def per_shard(dv):
-            local = {k: v[0] for k, v in dv.items()}
-            return run(local, cap)[None]
+        def per_shard(buf):
+            dv = unpack_input_entries(jnp, lax, buf[0], entries)
+            return run(dv, cap)[None]
 
         smap = _shard_map(jax)
         kwargs = dict(
@@ -112,15 +124,19 @@ class ShardedEncoder:
                 parts.append(arr)
             stacked[key] = np.stack(parts)
 
+        entries = input_entries(stacked, axis=1)
+        packed = np.concatenate(
+            [stacked[k].view(np.uint8).reshape(self.D, -1)
+             for k, _dt, _ln in entries],
+            axis=1,
+        )
         spec = jax.sharding.NamedSharding(
             self.mesh, jax.sharding.PartitionSpec("chunks")
         )
-        dv_d = {k: jax.device_put(v, spec) for k, v in stacked.items()}
-        shape_key = (cap,) + tuple(
-            sorted((k, v.shape) for k, v in stacked.items())
+        fn = self._sharded_fn(entries, cap)
+        blob = np.asarray(
+            jax.device_get(fn(jax.device_put(packed, spec)))
         )
-        fn = self._sharded_fn(shape_key, cap)
-        blob = np.asarray(jax.device_get(fn(dv_d)))
 
         out: List[pa.Array] = []
         R = stacked["#active:0"].shape[1]
