@@ -33,10 +33,10 @@ _SUPPORTED_LOGICAL = {
 }
 
 # The native host VM covers more than the device subset: bytes, fixed
-# (incl. duration), and the remaining integer-wire logical types. Still
-# excluded (served by the Python fallback): decimal (oracle semantics
-# are decimal-context arithmetic) and uuid (oracle accepts every text
-# form the stdlib UUID parser does).
+# (incl. duration and decimal128-representable decimals), and the
+# remaining integer-wire logical types. Still excluded (served by the
+# Python fallback): uuid (the oracle accepts every text form the
+# stdlib UUID parser does) and decimals past decimal128's range.
 _HOST_EXTRA_LOGICAL = {
     None: ("bytes",),
     "time-millis": ("int",),
@@ -52,6 +52,8 @@ def _inner(t: AvroType, extra=None) -> bool:
         if allowed is not None and t.name in allowed:
             return True
         if extra is not None:
+            if t.logical == "decimal":
+                return t.name == "bytes" and t.precision <= 38
             allowed = extra.get(t.logical)
             return allowed is not None and t.name in allowed
         return False
@@ -66,6 +68,10 @@ def _inner(t: AvroType, extra=None) -> bool:
     if isinstance(t, Map):
         return _inner(t.values, extra)
     if extra is not None and isinstance(t, Fixed):
+        if t.logical == "decimal":
+            # size 0 can hold no value at all — leave the oracle to
+            # produce its (always-raising) semantics for that corner
+            return 1 <= t.size <= 16 and t.precision <= 38
         return t.logical in (None, "duration")
     return False  # device path: Fixed (incl. decimal/duration), unknown
 

@@ -157,7 +157,10 @@ class NativeHostCodec:
                     blob, sizes = self._mod.encode(
                         self.prog.ops, self.prog.coltypes, bufs, n
                     )
-        except OverflowError:
+        except OverflowError as ex:
+            if "decimal" in str(ex):
+                raise  # oracle parity (int.to_bytes overflow) — a
+                # batch split cannot make the value fit
             raise BatchTooLarge(n, -1)
         sizes = np.frombuffer(sizes, np.int32)
         offsets = np.zeros(n + 1, np.int32)

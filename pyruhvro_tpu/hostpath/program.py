@@ -39,7 +39,7 @@ __all__ = ["HostProgram", "lower_host", "COL_NBUF"]
 # op kinds (≙ host_codec.cpp OpKind)
 OP_RECORD, OP_INT, OP_LONG, OP_FLOAT, OP_DOUBLE, OP_BOOL = 0, 1, 2, 3, 4, 5
 OP_STRING, OP_ENUM, OP_NULL, OP_NULLABLE, OP_UNION = 6, 7, 8, 9, 10
-OP_ARRAY, OP_MAP, OP_FIXED = 11, 12, 13
+OP_ARRAY, OP_MAP, OP_FIXED, OP_DEC_BYTES, OP_DEC_FIXED = 11, 12, 13, 14, 15
 
 # column types (≙ host_codec.cpp ColType)
 COL_I32, COL_I64, COL_F32, COL_F64, COL_U8, COL_STR, COL_OFFS = range(7)
@@ -130,14 +130,25 @@ class _HostLowering:
             elif name == "string":
                 self.emit(OP_STRING, col=self.col(path, COL_STR, region))
             elif name == "bytes":
-                # same wire form and builder as string; only the Arrow
-                # assembly differs (Binary, no UTF-8 check)
-                self.emit(OP_STRING, col=self.col(path, COL_STR, region))
+                if t.logical == "decimal":
+                    # wire: length-prefixed big-endian two's complement;
+                    # column: 16-byte LE decimal128 words
+                    self.emit(OP_DEC_BYTES,
+                              col=self.col(path + "#dec", COL_U8, region))
+                else:
+                    # same wire form and builder as string; only the
+                    # Arrow assembly differs (Binary, no UTF-8 check)
+                    self.emit(OP_STRING,
+                              col=self.col(path, COL_STR, region))
             else:  # pragma: no cover — gated by host_supported
                 raise UnsupportedOnDevice(f"primitive {name!r} at {path!r}")
         elif isinstance(t, Fixed):
-            self.emit(OP_FIXED, a=t.size,
-                      col=self.col(path + "#fix", COL_U8, region))
+            if t.logical == "decimal":
+                self.emit(OP_DEC_FIXED, a=t.size,
+                          col=self.col(path + "#dec", COL_U8, region))
+            else:
+                self.emit(OP_FIXED, a=t.size,
+                          col=self.col(path + "#fix", COL_U8, region))
         elif isinstance(t, Enum):
             self.emit(OP_ENUM, a=len(t.symbols),
                       col=self.col(path + "#v", COL_I32, region))

@@ -137,6 +137,8 @@ class _Assembler:
         name = t.name
         if name == "null":
             return pa.nulls(count, pa.null())
+        if name == "bytes" and t.logical == "decimal":
+            return self._decimal(dt, path, count, vbuf, nulls)
         if name in ("string", "bytes"):
             lens = self.host[path + "#len"][:count]
             total = int(lens.sum(dtype=np.int64))
@@ -214,12 +216,22 @@ class _Assembler:
             )
         raise NotImplementedError(name)
 
+    def _decimal(self, dt, path, count, vbuf, nulls):
+        """Decimal128 from the host VM's 16-byte-LE #dec words (the
+        exact Arrow decimal128 buffer layout)."""
+        raw = np.ascontiguousarray(self.host[path + "#dec"][: count * 16])
+        return pa.Array.from_buffers(
+            dt, count, [vbuf, pa.py_buffer(raw)], null_count=nulls
+        )
+
     def _fixed(self, t, dt, path, count, valid):
         """Avro ``fixed`` from the host VM's raw #fix byte column;
         ``duration`` converts fixed(12) (months, days, ms u32-LE) to
         Duration(ms) with the oracle's 30-day-month convention
         (``fallback/decoder.py``)."""
         vbuf, nulls = _validity(valid, count)
+        if t.logical == "decimal":
+            return self._decimal(dt, path, count, vbuf, nulls)
         raw = self.host[path + "#fix"][: count * t.size]
         if t.logical == "duration":
             u = np.ascontiguousarray(raw).view(np.uint32).reshape(count, 3)

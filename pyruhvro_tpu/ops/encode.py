@@ -646,6 +646,9 @@ class _Extractor:
         divmod arithmetic (``fallback/encoder.py``)."""
         n = len(arr)
         size = t.size
+        if t.logical == "decimal":
+            self._extract_decimal(arr, path, region)
+            return
         if t.logical == "duration":
             import pyarrow.compute as pc
 
@@ -722,10 +725,27 @@ class _Extractor:
         elif name == "string":
             self._extract_string(arr, path, region)
         elif name == "bytes":
-            # Binary shares Utf8's offsets+data layout
-            self._extract_string(arr, path, region)
+            if t.logical == "decimal":
+                self._extract_decimal(arr, path, region)
+            else:
+                # Binary shares Utf8's offsets+data layout
+                self._extract_string(arr, path, region)
         else:
             raise UnsupportedOnDevice(f"primitive {name!r} at {path!r}")
+
+    def _extract_decimal(self, arr, path, region) -> None:
+        """Decimal128 values buffer: 16 bytes LE per entry (what the
+        encode VM's OP_DEC ops consume)."""
+        n = len(arr)
+        buf = arr.buffers()[1]
+        if buf is None:
+            raw = np.zeros(n * 16, np.uint8)
+        else:
+            raw = np.frombuffer(
+                buf, np.uint8, count=(arr.offset + n) * 16
+            )[arr.offset * 16:]
+        self.put(path + "#dec", raw, region)
+        self.bound += 18 * n  # ≤16 value bytes + length varint
 
     def _extract_string(self, arr, path, region) -> None:
         n = len(arr)

@@ -58,6 +58,7 @@ ERR_BAD_ENUM = 1 << 4      # enum index out of range
 ERR_TRAILING = 1 << 5      # datum not fully consumed (trailing bytes)
 ERR_BAD_BOOL = 1 << 6      # boolean byte not 0/1
 ERR_ITEM_OVERFLOW = 1 << 7 # array/map items exceeded the slot cap (retry)
+ERR_DEC_RANGE = 1 << 8     # decimal outside decimal128 (host VM only)
 
 ERR_NAMES = {
     ERR_VARINT: "varint longer than 10 bytes",
@@ -68,6 +69,7 @@ ERR_NAMES = {
     ERR_TRAILING: "trailing bytes after datum",
     ERR_BAD_BOOL: "invalid boolean byte",
     ERR_ITEM_OVERFLOW: "array/map item capacity overflow",
+    ERR_DEC_RANGE: "decimal outside decimal128 range",
 }
 
 

@@ -51,7 +51,9 @@ enum OpKind : int32_t {
   OP_UNION = 10,
   OP_ARRAY = 11,
   OP_MAP = 12,
-  OP_FIXED = 13,  // a = byte size; col = raw bytes (size per entry)
+  OP_FIXED = 13,      // a = byte size; col = raw bytes (size per entry)
+  OP_DEC_BYTES = 14,  // decimal over bytes; col = 16B LE words
+  OP_DEC_FIXED = 15,  // a = byte size; decimal over fixed; col = 16B LE
 };
 
 // ---- column types (keep in sync with hostpath/program.py) ------------
@@ -74,6 +76,7 @@ enum Err : int32_t {
   ERR_BAD_ENUM = 1 << 4,
   ERR_TRAILING = 1 << 5,
   ERR_BAD_BOOL = 1 << 6,
+  ERR_DEC_RANGE = 1 << 8,  // decimal outside decimal128's 128-bit range
 };
 
 struct Op {
@@ -198,6 +201,45 @@ class Vm {
           if (present) r.err |= ERR_OVERRUN;
           c.u8.insert(c.u8.end(), (size_t)nsz, 0);  // keep lengths aligned
         }
+        return pc + 1;
+      }
+      case OP_DEC_BYTES:
+      case OP_DEC_FIXED: {
+        Col& c = (*cols_)[op.col];
+        int64_t len = 0;
+        if (present) {
+          if (op.kind == OP_DEC_BYTES) {
+            len = r.read_zigzag();
+            if (len < 0) {
+              r.err |= ERR_NEG_LEN;
+              len = 0;
+            }
+          } else {
+            len = op.a;
+          }
+          if (len > r.end - r.cur) {
+            r.err |= ERR_OVERRUN;
+            len = 0;
+          }
+        }
+        // big-endian two's complement (any length; non-minimal and
+        // over-long sign-extended forms accepted like the oracle's
+        // int.from_bytes) -> one 16-byte LE decimal128 word
+        uint8_t out16[16];
+        uint8_t fill =
+            (len > 0 && (r.base[r.cur] & 0x80)) ? 0xFF : 0x00;
+        std::memset(out16, fill, 16);
+        int64_t take = len < 16 ? len : 16;
+        for (int64_t i = 0; i < take; i++)
+          out16[i] = r.base[r.cur + len - 1 - i];
+        if (len > 16) {
+          for (int64_t i = 0; i + 16 < len; i++)
+            if (r.base[r.cur + i] != fill) r.err |= ERR_DEC_RANGE;
+          if (((out16[15] & 0x80) ? 0xFF : 0x00) != fill)
+            r.err |= ERR_DEC_RANGE;
+        }
+        r.cur += present ? len : 0;
+        c.u8.insert(c.u8.end(), out16, out16 + 16);
         return pc + 1;
       }
       case OP_ENUM: {
@@ -525,10 +567,19 @@ inline void write_zigzag(std::vector<uint8_t>& out, int64_t v) {
   write_varint(out, ((uint64_t)v << 1) ^ (uint64_t)(v >> 63));
 }
 
+inline int bitlen128(unsigned __int128 a) {
+  uint64_t hi = (uint64_t)(a >> 64), lo = (uint64_t)a;
+  if (hi) return 128 - __builtin_clzll(hi);
+  if (lo) return 64 - __builtin_clzll(lo);
+  return 0;
+}
+
 class EncVm {
  public:
   EncVm(const Op* ops, std::vector<InCol>* cols, std::vector<uint8_t>* out)
       : ops_(ops), cols_(cols), out_(out) {}
+
+  bool err = false;  // decimal didn't fit its fixed size
 
   size_t exec(size_t pc, bool present) {
     const Op& op = ops_[pc];
@@ -587,6 +638,43 @@ class EncVm {
         if (present)
           out_->insert(out_->end(), c.u8 + c.cur, c.u8 + c.cur + nsz);
         c.cur += nsz;
+        return pc + 1;
+      }
+      case OP_DEC_BYTES:
+      case OP_DEC_FIXED: {
+        // 16B LE decimal128 word -> big-endian two's complement; the
+        // length rule reproduces the oracle exactly:
+        // max((abs_bit_length + 8) // 8, 1), i.e. deliberately
+        // non-minimal for negative powers of two
+        InCol& c = (*cols_)[op.col];
+        const uint8_t* p = c.u8 + c.cur;
+        c.cur += 16;
+        if (!present) return pc + 1;
+        unsigned __int128 v = 0;
+        for (int i = 15; i >= 0; i--) v = (v << 8) | p[i];
+        bool neg = (p[15] & 0x80) != 0;
+        unsigned __int128 a = neg ? (unsigned __int128)(~v + 1) : v;
+        int bits = bitlen128(a);
+        int64_t n;
+        if (op.kind == OP_DEC_BYTES) {
+          n = ((int64_t)bits + 8) / 8;
+          if (n < 1) n = 1;
+          write_zigzag(*out_, n);
+        } else {
+          n = op.a;
+          if (n < 16) {  // signed-range fit (≙ int.to_bytes overflow)
+            unsigned __int128 lim = (unsigned __int128)1 << (8 * n - 1);
+            if (neg ? (a > lim) : (a >= lim)) {
+              err = true;
+              return pc + 1;
+            }
+          }
+        }
+        for (int64_t i = 0; i < n; i++) {
+          int shift = (int)(8 * (n - 1 - i));
+          out_->push_back(
+              shift >= 128 ? (neg ? 0xFF : 0x00) : (uint8_t)(v >> shift));
+        }
         return pc + 1;
       }
       case OP_NULL:
@@ -841,6 +929,7 @@ PyObject* py_encode(PyObject*, PyObject* args) {
   std::vector<uint8_t> out;
   std::vector<int32_t> sizes((size_t)n);
   bool overflow = false;
+  bool vm_err = false;
   Py_BEGIN_ALLOW_THREADS;
   try {
     out.reserve(size_hint > 0 ? (size_t)size_hint : (size_t)n * 32);
@@ -851,6 +940,10 @@ PyObject* py_encode(PyObject*, PyObject* args) {
   size_t prev = 0;
   for (Py_ssize_t i = 0; i < n; i++) {
     vm.exec(0, true);
+    if (vm.err) {
+      vm_err = true;
+      break;
+    }
     size_t sz = out.size() - prev;
     if (out.size() > (size_t)INT32_MAX) {
       overflow = true;
@@ -864,6 +957,12 @@ PyObject* py_encode(PyObject*, PyObject* args) {
   if (overflow) {
     PyErr_SetString(PyExc_OverflowError,
                     "encoded batch exceeds int32 binary offsets");
+    return nullptr;
+  }
+  if (vm_err) {
+    // same error class as the oracle's int.to_bytes overflow
+    PyErr_SetString(PyExc_OverflowError,
+                    "decimal value does not fit its fixed size");
     return nullptr;
   }
   PyObject* blob = bytes_from(out.data(), out.size());

@@ -232,7 +232,8 @@ def random_schema(seed: int, max_depth: int = 3) -> str:
     generated ones). Respects Avro's union rules: no nested unions, at
     most one variant per unnamed kind. ``duration`` is excluded — its
     random 12-byte fixeds overflow the oracle's Duration(ms) int64 by
-    construction (covered by targeted tests instead)."""
+    construction (covered by targeted tests instead); decimals stay
+    within precision so both paths are exact."""
     import json as _json
 
     rng = random.Random(seed)
@@ -259,11 +260,20 @@ def random_schema(seed: int, max_depth: int = 3) -> str:
             leaf = rng.choice(LEAVES + [None, None])  # None → named leaf
             if leaf is not None:
                 return leaf
-            if rng.random() < 0.5:
+            named = rng.random()
+            if named < 0.34:
                 return {"type": "enum", "name": fresh("E"),
                         "symbols": ["A", "B", "C", "D"][: rng.randint(2, 4)]}
-            return {"type": "fixed", "name": fresh("F"),
-                    "size": rng.randint(1, 16)}
+            if named < 0.67:
+                return {"type": "fixed", "name": fresh("F"),
+                        "size": rng.randint(1, 16)}
+            prec = rng.randint(1, 18)
+            if rng.random() < 0.5:
+                return {"type": "bytes", "logicalType": "decimal",
+                        "precision": prec, "scale": rng.randint(0, prec)}
+            return {"type": "fixed", "name": fresh("FD"), "size": 16,
+                    "logicalType": "decimal", "precision": prec,
+                    "scale": rng.randint(0, prec)}
         if roll < 0.60:
             return {"type": "array", "items": gen_type(depth + 1)}
         if roll < 0.72:

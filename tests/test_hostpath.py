@@ -252,20 +252,59 @@ def test_extended_subset_served_by_api_auto():
     assert got.equals(decode_to_record_batch(datums, e.ir, e.arrow_schema))
 
 
-def test_decimal_and_uuid_stay_on_python_fallback():
+def test_uuid_and_oversize_decimal_stay_on_python_fallback():
     from pyruhvro_tpu.gate import host_supported
 
-    dec = get_or_parse_schema(
-        '{"type":"record","name":"D","fields":[{"name":"d","type":'
-        '{"type":"bytes","logicalType":"decimal","precision":10,'
-        '"scale":2}}]}'
-    )
     uu = get_or_parse_schema(
         '{"type":"record","name":"U","fields":[{"name":"u","type":'
         '{"type":"string","logicalType":"uuid"}}]}'
     )
-    assert not host_supported(dec.ir)
     assert not host_supported(uu.ir)
+    # fixed-decimal wider than decimal128's 16 bytes: python path
+    wide = get_or_parse_schema(
+        '{"type":"record","name":"W","fields":[{"name":"d","type":'
+        '{"type":"fixed","name":"FW","size":20,"logicalType":"decimal",'
+        '"precision":38,"scale":0}}]}'
+    )
+    assert not host_supported(wide.ir)
+
+
+def test_decimal_through_vm():
+    """bytes- and fixed-decimals decode/encode through the VM with the
+    oracle's exact wire rules (incl. the non-minimal length for
+    negative powers of two, e.g. -128 → two bytes)."""
+    import decimal as _d
+
+    from pyruhvro_tpu.fallback.encoder import (
+        compile_encoder_plan,
+        encode_record_batch,
+    )
+
+    schema = (
+        '{"type":"record","name":"D","fields":['
+        '{"name":"b","type":{"type":"bytes","logicalType":"decimal",'
+        '"precision":38,"scale":3}},'
+        '{"name":"f","type":{"type":"fixed","name":"FD","size":9,'
+        '"logicalType":"decimal","precision":20,"scale":2}}]}'
+    )
+    e, c = _codec(schema)
+    vals = [0, 1, -1, -128, 128, 2**63, -(2**63), 10**37, -(10**37)]
+    batch = pa.RecordBatch.from_pydict({
+        "b": pa.array(
+            [_d.Decimal(v).scaleb(-3) for v in vals], pa.decimal128(38, 3)
+        ),
+        "f": pa.array(
+            [_d.Decimal(v % 10**19).scaleb(-2) for v in vals],
+            pa.decimal128(20, 2),
+        ),
+    })
+    datums = [
+        bytes(d)
+        for d in encode_record_batch(batch, e.ir, compile_encoder_plan(e.ir))
+    ]
+    want = decode_to_record_batch(datums, e.ir, e.arrow_schema)
+    assert c.decode(datums).equals(want)
+    assert [bytes(x) for x in c.encode(want)] == datums
 
 
 def test_truncated_fixed_raises():
