@@ -32,11 +32,11 @@ _SUPPORTED_LOGICAL = {
     "timestamp-micros": ("long",),
 }
 
-# The native host VM covers more than the device subset: bytes, fixed
-# (incl. duration and decimal128-representable decimals), and the
-# remaining integer-wire logical types. Still excluded (served by the
-# Python fallback): uuid (the oracle accepts every text form the
-# stdlib UUID parser does) and decimals past decimal128's range.
+# The native host VM covers the reference's FULL type surface: the
+# fast subset plus bytes, fixed (incl. duration and
+# decimal128-representable decimals), uuid, and the remaining
+# integer-wire logical types. The only exclusion (served by the Python
+# fallback): fixed-decimals wider than decimal128's 16 bytes.
 _HOST_EXTRA_LOGICAL = {
     None: ("bytes",),
     "time-millis": ("int",),
@@ -54,6 +54,11 @@ def _inner(t: AvroType, extra=None) -> bool:
         if extra is not None:
             if t.logical == "decimal":
                 return t.name == "bytes" and t.precision <= 38
+            if t.logical == "uuid":
+                # wire is a plain string; the text↔16-byte conversion
+                # happens in the Arrow assembly (vectorized canonical
+                # path, stdlib-UUID fallback = the oracle's own parser)
+                return t.name == "string"
             allowed = extra.get(t.logical)
             return allowed is not None and t.name in allowed
         return False

@@ -128,6 +128,8 @@ class _HostLowering:
             elif name == "boolean":
                 self.emit(OP_BOOL, col=self.col(path + "#v", COL_U8, region))
             elif name == "string":
+                # incl. uuid: the wire form is a plain string; the
+                # text→16-byte conversion is the assembler's job
                 self.emit(OP_STRING, col=self.col(path, COL_STR, region))
             elif name == "bytes":
                 if t.logical == "decimal":

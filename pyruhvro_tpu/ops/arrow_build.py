@@ -138,32 +138,11 @@ class _Assembler:
         if name == "null":
             return pa.nulls(count, pa.null())
         if name == "bytes" and t.logical == "decimal":
-            return self._decimal(dt, path, count, vbuf, nulls)
+            return self._decimal(t, dt, path, count, vbuf, nulls, valid)
+        if name == "string" and t.logical == "uuid":
+            return self._uuid(dt, path, count, vbuf, nulls, valid)
         if name in ("string", "bytes"):
-            lens = self.host[path + "#len"][:count]
-            total = int(lens.sum(dtype=np.int64))
-            if total >= (1 << 31):
-                # int32 offsets would wrap; the oracle's pa.array raises
-                # the same error class here
-                raise pa.lib.ArrowCapacityError(
-                    f"column {path!r} carries {total} value bytes — over "
-                    f"the 2 GiB Binary/Utf8 capacity; split the batch"
-                )
-            voff = np.zeros(count + 1, np.int32)
-            np.cumsum(lens, out=voff[1:])
-            if path + "#bytes" in self.host:
-                # the native host VM copies value bytes contiguously
-                # during its walk; use them directly
-                values = self.host[path + "#bytes"][:total]
-            else:
-                # device walk ships (start, len) only: values are
-                # gathered here, on the host, from the original datum
-                # bytes — they never cross the device interconnect
-                starts = self.host[path + "#start"][:count]
-                src = np.repeat(
-                    starts.astype(np.int64) - voff[:-1], lens
-                ) + np.arange(total, dtype=np.int64)
-                values = self.flat[src]
+            values, voff, _lens = self._string_values(path, count)
             if name == "string":
                 _check_utf8(values, voff, path)
             return pa.Array.from_buffers(
@@ -216,10 +195,109 @@ class _Assembler:
             )
         raise NotImplementedError(name)
 
-    def _decimal(self, dt, path, count, vbuf, nulls):
+    def _string_values(self, path: str, count: int):
+        """Materialize one string-ish column's ``(values, voff, lens)``
+        from either layout — the host VM's contiguous ``#bytes`` or the
+        device walk's ``(start, len)`` descriptors gathered from the
+        original datum bytes — with the 2 GiB int32-offset guard (the
+        oracle's ``pa.array`` raises the same error class)."""
+        lens = self.host[path + "#len"][:count]
+        total = int(lens.sum(dtype=np.int64))
+        if total >= (1 << 31):
+            raise pa.lib.ArrowCapacityError(
+                f"column {path!r} carries {total} value bytes — over "
+                f"the 2 GiB Binary/Utf8 capacity; split the batch"
+            )
+        voff = np.zeros(count + 1, np.int32)
+        np.cumsum(lens, out=voff[1:])
+        if path + "#bytes" in self.host:
+            values = self.host[path + "#bytes"][:total]
+        else:
+            starts = self.host[path + "#start"][:count]
+            src = np.repeat(
+                starts.astype(np.int64) - voff[:-1], lens
+            ) + np.arange(total, dtype=np.int64)
+            values = self.flat[src]
+        return values, voff, lens
+
+    # char → nibble; 0xFF marks non-hex
+    _HEX_LUT = np.full(256, 0xFF, np.uint8)
+    for i, ch in enumerate(b"0123456789abcdef"):
+        _HEX_LUT[ch] = i
+    for i, ch in enumerate(b"ABCDEF"):
+        _HEX_LUT[ch] = 10 + i
+    del i, ch
+
+    def _uuid(self, dt, path, count, vbuf, nulls, valid):
+        """uuid text → FixedSizeBinary(16). Live rows in the canonical
+        36-char form (dashes at 8/13/18/23) convert vectorized; anything
+        else goes through the stdlib ``uuid.UUID`` — the oracle's own
+        parser (``fallback/decoder.py``), so exotic-but-accepted forms
+        and error behavior match by construction. Dead rows (nulls,
+        non-selected union arms) emit zero bytes."""
+        values, voff, lens = self._string_values(path, count)
+        _check_utf8(values, voff, path)
+
+        out = np.zeros((count, 16), np.uint8)
+        live = (
+            np.ones(count, bool) if valid is None else valid.astype(bool)
+        )
+        canonical = np.zeros(count, bool)
+        cand = np.flatnonzero(live & (lens == 36))
+        if cand.size:
+            m = values[
+                voff[:-1][cand, None].astype(np.int64) + np.arange(36)
+            ]
+            keep = np.delete(np.arange(36), [8, 13, 18, 23])
+            nib = self._HEX_LUT[m[:, keep]]
+            ok = (m[:, [8, 13, 18, 23]] == ord("-")).all(axis=1) & (
+                nib != 0xFF
+            ).all(axis=1)
+            rows = cand[ok]
+            out[rows] = (nib[ok, 0::2] << 4) | nib[ok, 1::2]
+            canonical[rows] = True
+        rest = np.flatnonzero(live & ~canonical)
+        if rest.size:
+            import uuid as _uuid_mod
+
+            for i in rest:
+                s = values[voff[i] : voff[i + 1]].tobytes().decode("utf-8")
+                out[i] = np.frombuffer(_uuid_mod.UUID(s).bytes, np.uint8)
+        return pa.Array.from_buffers(
+            dt, count,
+            [vbuf, pa.py_buffer(np.ascontiguousarray(out).reshape(-1))],
+            null_count=nulls,
+        )
+
+    def _decimal(self, t, dt, path, count, vbuf, nulls, valid):
         """Decimal128 from the host VM's 16-byte-LE #dec words (the
-        exact Arrow decimal128 buffer layout)."""
+        exact Arrow decimal128 buffer layout), validating live values
+        against the declared precision — the oracle's ``pa.array``
+        raises ArrowInvalid for over-precision values, and
+        ``from_buffers`` would silently accept them."""
         raw = np.ascontiguousarray(self.host[path + "#dec"][: count * 16])
+        if count:
+            words = raw.view(np.uint64).reshape(count, 2)
+            lo, hi = words[:, 0], words[:, 1]
+            neg = (hi >> np.uint64(63)) != 0
+            # |v| over two u64 halves (two's-complement negate)
+            lo_a = np.where(neg, (~lo) + np.uint64(1), lo)
+            hi_a = np.where(neg, (~hi) + (lo == 0).astype(np.uint64), hi)
+            bound = 10 ** t.precision
+            b_hi = np.uint64(bound >> 64)
+            b_lo = np.uint64(bound & ((1 << 64) - 1))
+            fits = (hi_a < b_hi) | ((hi_a == b_hi) & (lo_a < b_lo))
+            live = (
+                np.ones(count, bool) if valid is None
+                else valid.astype(bool)
+            )
+            bad = live & ~fits
+            if bad.any():
+                i = int(np.flatnonzero(bad)[0])
+                raise pa.lib.ArrowInvalid(
+                    f"decimal at {path!r} row {i} exceeds precision "
+                    f"{t.precision}"
+                )
         return pa.Array.from_buffers(
             dt, count, [vbuf, pa.py_buffer(raw)], null_count=nulls
         )
@@ -231,7 +309,7 @@ class _Assembler:
         (``fallback/decoder.py``)."""
         vbuf, nulls = _validity(valid, count)
         if t.logical == "decimal":
-            return self._decimal(dt, path, count, vbuf, nulls)
+            return self._decimal(t, dt, path, count, vbuf, nulls, valid)
         raw = self.host[path + "#fix"][: count * t.size]
         if t.logical == "duration":
             u = np.ascontiguousarray(raw).view(np.uint32).reshape(count, 3)

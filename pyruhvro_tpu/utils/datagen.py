@@ -252,6 +252,7 @@ def random_schema(seed: int, max_depth: int = 3) -> str:
         {"type": "long", "logicalType": "time-micros"},
         {"type": "long", "logicalType": "local-timestamp-millis"},
         {"type": "long", "logicalType": "local-timestamp-micros"},
+        {"type": "string", "logicalType": "uuid"},
     ]
 
     def gen_type(depth, allow_union=True):

@@ -252,14 +252,9 @@ def test_extended_subset_served_by_api_auto():
     assert got.equals(decode_to_record_batch(datums, e.ir, e.arrow_schema))
 
 
-def test_uuid_and_oversize_decimal_stay_on_python_fallback():
+def test_oversize_decimal_stays_on_python_fallback():
     from pyruhvro_tpu.gate import host_supported
 
-    uu = get_or_parse_schema(
-        '{"type":"record","name":"U","fields":[{"name":"u","type":'
-        '{"type":"string","logicalType":"uuid"}}]}'
-    )
-    assert not host_supported(uu.ir)
     # fixed-decimal wider than decimal128's 16 bytes: python path
     wide = get_or_parse_schema(
         '{"type":"record","name":"W","fields":[{"name":"d","type":'
@@ -267,6 +262,42 @@ def test_uuid_and_oversize_decimal_stay_on_python_fallback():
         '"precision":38,"scale":0}}]}'
     )
     assert not host_supported(wide.ir)
+
+
+def test_uuid_through_vm():
+    """uuid strings decode to FixedSizeBinary(16) via the vectorized
+    canonical path, with exotic-but-stdlib-accepted forms and invalid
+    forms matching the oracle exactly (it IS the oracle's parser for
+    those)."""
+    from pyruhvro_tpu.fallback.io import write_long
+
+    schema = ('{"type":"record","name":"UU","fields":[{"name":"u",'
+              '"type":{"type":"string","logicalType":"uuid"}}]}')
+    e, c = _codec(schema)
+
+    def mk(text):
+        b = bytearray()
+        s = text.encode()
+        write_long(b, len(s))
+        return bytes(b + s)
+
+    wire = [
+        mk("12345678-1234-5678-1234-567812345678"),
+        mk("urn:uuid:12345678-1234-5678-1234-567812345678"),
+        mk("{ABCDEF00-1234-5678-1234-567812345678}"),
+        mk("12345678123456781234567812345678"),
+    ]
+    want = decode_to_record_batch(wire, e.ir, e.arrow_schema)
+    assert c.decode(wire).equals(want)
+    # encode emits canonical lowercase text (str(UUID(bytes=...)))
+    assert [bytes(x) for x in c.encode(want)] == [
+        mk("12345678-1234-5678-1234-567812345678"),
+        mk("12345678-1234-5678-1234-567812345678"),
+        mk("abcdef00-1234-5678-1234-567812345678"),
+        mk("12345678-1234-5678-1234-567812345678"),
+    ]
+    with pytest.raises(ValueError):
+        c.decode([mk("junk")])
 
 
 def test_decimal_through_vm():
