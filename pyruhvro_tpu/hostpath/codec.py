@@ -141,10 +141,12 @@ class NativeHostCodec:
         with metrics.timer("host.extract_s"):
             ex = run_extractor(self.ir, batch)
             bufs = self._encode_buffers(ex)
-        # pre-size the output vector; the extractor's bound is loose
-        # (10 B/long regardless of varint width), so clamp the eager
-        # allocation — past the clamp, geometric growth takes over
-        hint = min(ex.bound, 64 << 20)
+        # the extractor's bound is a STRICT upper bound on the wire
+        # total (loose: 10 B/long regardless of varint width), which
+        # lets the VM write unchecked into a single allocation of that
+        # size; past 1 GiB of bound, hint=0 selects the VM's
+        # capacity-checked growth path instead of a giant eager alloc
+        hint = ex.bound if ex.bound <= (1 << 30) else 0
         try:
             with metrics.timer("host.encode_vm_s"):
                 try:

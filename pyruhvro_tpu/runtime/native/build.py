@@ -25,20 +25,57 @@ def _so_path(mod_name: str) -> str:
     return os.path.join(_HERE, mod_name + suffix)
 
 
+def _cpu_tag() -> str:
+    """A stable fingerprint of this host's ISA surface. Guards the
+    ``-march=native`` build cache: a .so baked on one machine (container
+    image build, shared install) must not run on a host lacking those
+    extensions — mtime alone cannot see that."""
+    import hashlib
+    import platform
+
+    flags = ""
+    try:
+        with open("/proc/cpuinfo") as f:
+            for line in f:
+                if line.startswith(("flags", "Features")):
+                    flags = " ".join(sorted(line.split(":", 1)[1].split()))
+                    break
+    except OSError:
+        pass
+    return hashlib.sha256(
+        (platform.machine() + "|" + flags).encode()
+    ).hexdigest()[:16]
+
+
 def _needs_build(so: str, src: str) -> bool:
-    return (not os.path.exists(so)) or os.path.getmtime(so) < os.path.getmtime(src)
+    if (not os.path.exists(so)) or os.path.getmtime(so) < os.path.getmtime(src):
+        return True
+    try:
+        with open(so + ".buildinfo") as f:
+            return f.read().strip() != _cpu_tag()
+    except OSError:
+        return True  # unknown build host: rebuild for this one
 
 
 def _compile(so: str, src: str) -> None:
     include = sysconfig.get_paths()["include"]
     tmp = f"{so}.{os.getpid()}.tmp"  # per-process: concurrent builds can't clobber
-    cmd = [
+    base = [
         "g++", "-O3", "-shared", "-fPIC", "-std=c++17", "-pthread",
         "-I", include, src, "-o", tmp,
     ]
     try:
-        subprocess.run(cmd, check=True, capture_output=True, text=True)
+        # this build runs on the machine that will execute the code
+        # (compile-at-first-import), so -march=native is safe here; the
+        # portable wheel build (setup.py) keeps generic flags
+        try:
+            subprocess.run(base[:1] + ["-march=native"] + base[1:],
+                           check=True, capture_output=True, text=True)
+        except subprocess.CalledProcessError:
+            subprocess.run(base, check=True, capture_output=True, text=True)
         os.replace(tmp, so)
+        with open(so + ".buildinfo", "w") as f:
+            f.write(_cpu_tag())
     finally:
         if os.path.exists(tmp):
             os.unlink(tmp)

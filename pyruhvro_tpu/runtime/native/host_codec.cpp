@@ -105,7 +105,32 @@ struct Reader {
   int32_t err = 0;
 
   inline uint64_t read_raw_varint() {
-    // unrolled-bounds LEB128, wire max 10 bytes
+    // 1-byte fast path: the overwhelmingly common case on real data
+    // (branch indices, block counts, short lengths, small ints)
+    if (cur < end) {
+      uint8_t b0 = base[cur];
+      if (b0 < 0x80) {
+        cur++;
+        return b0;
+      }
+      if (end - cur >= 10) {  // full wire max in-span: no per-byte bounds
+        const uint8_t* p = base + cur;
+        uint64_t v = b0 & 0x7F;
+        int shift = 7;
+        for (int k = 1; k < 10; k++) {
+          uint8_t byte = p[k];
+          v |= (uint64_t)(byte & 0x7F) << shift;
+          if (byte < 0x80) {
+            cur += k + 1;
+            return v;
+          }
+          shift += 7;
+        }
+        err |= ERR_VARINT;
+        return 0;
+      }
+    }
+    // tail path: per-byte bounds near the record end
     uint64_t v = 0;
     int shift = 0;
     for (int k = 0; k < 10; k++) {
@@ -555,15 +580,46 @@ struct InCol {
   size_t bcur = 0;                 // COL_STR byte cursor
 };
 
-inline void write_varint(std::vector<uint8_t>& out, uint64_t v) {
+// Output sinks for the encode VM: RawWriter assumes the caller
+// allocated the extractor's byte BOUND upfront (a strict upper bound on
+// the wire total, ops/encode.py), so every write is unchecked; VecWriter
+// is the capacity-checked fallback when no bound is available.
+struct RawWriter {
+  uint8_t* p;
+  const uint8_t* base;
+  inline void push(uint8_t b) { *p++ = b; }
+  inline void append(const void* s, size_t n) {
+    std::memcpy(p, s, n);
+    p += n;
+  }
+  inline size_t pos() const { return (size_t)(p - base); }
+};
+
+struct VecWriter {
+  std::vector<uint8_t>* v;
+  inline void push(uint8_t b) { v->push_back(b); }
+  inline void append(const void* s, size_t n) {
+    const uint8_t* s8 = static_cast<const uint8_t*>(s);
+    v->insert(v->end(), s8, s8 + n);
+  }
+  inline size_t pos() const { return v->size(); }
+};
+
+template <class W>
+inline void write_varint(W& out, uint64_t v) {
+  if (v < 0x80) {  // dominant case: branch bytes, counts, short lengths
+    out.push((uint8_t)v);
+    return;
+  }
   while (v >= 0x80) {
-    out.push_back((uint8_t)(v | 0x80));
+    out.push((uint8_t)(v | 0x80));
     v >>= 7;
   }
-  out.push_back((uint8_t)v);
+  out.push((uint8_t)v);
 }
 
-inline void write_zigzag(std::vector<uint8_t>& out, int64_t v) {
+template <class W>
+inline void write_zigzag(W& out, int64_t v) {
   write_varint(out, ((uint64_t)v << 1) ^ (uint64_t)(v >> 63));
 }
 
@@ -574,9 +630,10 @@ inline int bitlen128(unsigned __int128 a) {
   return 0;
 }
 
+template <class W>
 class EncVm {
  public:
-  EncVm(const Op* ops, std::vector<InCol>* cols, std::vector<uint8_t>* out)
+  EncVm(const Op* ops, std::vector<InCol>* cols, W* out)
       : ops_(ops), cols_(cols), out_(out) {}
 
   bool err = false;  // decimal didn't fit its fixed size
@@ -608,7 +665,7 @@ class EncVm {
         if (present) {
           uint8_t b[4];
           std::memcpy(b, &v, 4);
-          out_->insert(out_->end(), b, b + 4);
+          out_->append(b, 4);
         }
         return pc + 1;
       }
@@ -618,14 +675,14 @@ class EncVm {
         if (present) {
           uint8_t b[8];
           std::memcpy(b, &v, 8);
-          out_->insert(out_->end(), b, b + 8);
+          out_->append(b, 8);
         }
         return pc + 1;
       }
       case OP_BOOL: {
         InCol& c = (*cols_)[op.col];
         uint8_t v = c.u8[c.cur++];
-        if (present) out_->push_back(v ? 1 : 0);
+        if (present) out_->push(v ? 1 : 0);
         return pc + 1;
       }
       case OP_STRING: {
@@ -636,7 +693,7 @@ class EncVm {
         InCol& c = (*cols_)[op.col];
         size_t nsz = (size_t)op.a;
         if (present)
-          out_->insert(out_->end(), c.u8 + c.cur, c.u8 + c.cur + nsz);
+          out_->append(c.u8 + c.cur, nsz);
         c.cur += nsz;
         return pc + 1;
       }
@@ -672,7 +729,7 @@ class EncVm {
         }
         for (int64_t i = 0; i < n; i++) {
           int shift = (int)(8 * (n - 1 - i));
-          out_->push_back(
+          out_->push(
               shift >= 128 ? (neg ? 0xFF : 0x00) : (uint8_t)(v >> shift));
         }
         return pc + 1;
@@ -705,7 +762,7 @@ class EncVm {
           if (is_map) write_string((*cols_)[op.b], present);
           exec(pc + 1, present);
         }
-        if (present) out_->push_back(0);  // block terminator
+        if (present) out_->push(0);  // block terminator
         return pc + 1 + ops_[pc + 1].nops;
       }
     }
@@ -718,15 +775,39 @@ class EncVm {
     if (present) {
       write_zigzag(*out_, (int64_t)len);
       if (len)
-        out_->insert(out_->end(), c.bytes + c.bcur, c.bytes + c.bcur + len);
+        out_->append(c.bytes + c.bcur, (size_t)len);
     }
     c.bcur += (size_t)len;
   }
 
   const Op* ops_;
   std::vector<InCol>* cols_;
-  std::vector<uint8_t>* out_;
+  W* out_;
 };
+
+// The per-record encode loop, shared by both writer strategies: runs
+// the VM once per row, records per-record sizes, stops on decimal
+// overflow (vm_err) or when the running total passes int32 offsets.
+template <class W>
+void run_encode(const Op* ops, std::vector<InCol>& cols, W& w, Py_ssize_t n,
+                int32_t* sizes, bool* overflow, bool* vm_err) {
+  EncVm<W> vm(ops, &cols, &w);
+  size_t prev = 0;
+  for (Py_ssize_t i = 0; i < n; i++) {
+    vm.exec(0, true);
+    if (vm.err) {
+      *vm_err = true;
+      return;
+    }
+    size_t pos = w.pos();
+    if (pos > (size_t)INT32_MAX) {
+      *overflow = true;
+      return;
+    }
+    sizes[i] = (int32_t)(pos - prev);
+    prev = pos;
+  }
+}
 
 int pick_threads(int64_t nrows, int requested) {
   if (requested > 0) return requested;
@@ -926,50 +1007,57 @@ PyObject* py_encode(PyObject*, PyObject* args) {
     return nullptr;
   }
 
-  std::vector<uint8_t> out;
   std::vector<int32_t> sizes((size_t)n);
   bool overflow = false;
   bool vm_err = false;
-  Py_BEGIN_ALLOW_THREADS;
-  try {
-    out.reserve(size_hint > 0 ? (size_t)size_hint : (size_t)n * 32);
-  } catch (const std::bad_alloc&) {
-    // the hint is advisory; fall back to geometric growth
-  }
-  EncVm vm(ops, &cols, &out);
-  size_t prev = 0;
-  for (Py_ssize_t i = 0; i < n; i++) {
-    vm.exec(0, true);
-    if (vm.err) {
-      vm_err = true;
-      break;
+
+  // Fast path: ``size_hint`` is the extractor's strict upper bound on
+  // the wire total (ops/encode.py sums per-type varint maxima + exact
+  // string bytes), so the final blob is allocated ONCE at the bound and
+  // every VM write is an unchecked raw-pointer store; the bytes object
+  // is shrunk to the real size at the end. Falls back to the
+  // capacity-checked vector writer when no bound is given or the eager
+  // allocation fails. The record loop itself is shared (run_encode).
+  PyObject* blob = nullptr;
+  if (size_hint > 0) blob = PyBytes_FromStringAndSize(nullptr, size_hint);
+  if (blob != nullptr) {
+    uint8_t* base = reinterpret_cast<uint8_t*>(PyBytes_AS_STRING(blob));
+    RawWriter w{base, base};
+    Py_BEGIN_ALLOW_THREADS;
+    run_encode(ops, cols, w, n, sizes.data(), &overflow, &vm_err);
+    Py_END_ALLOW_THREADS;
+    Py_DECREF(seq);
+    if (overflow || vm_err) {
+      Py_DECREF(blob);
+      PyErr_SetString(PyExc_OverflowError,
+                      overflow ? "encoded batch exceeds int32 binary offsets"
+                               : "decimal value does not fit its fixed size");
+      return nullptr;
     }
-    size_t sz = out.size() - prev;
-    if (out.size() > (size_t)INT32_MAX) {
-      overflow = true;
-      break;
+    if (_PyBytes_Resize(&blob, (Py_ssize_t)w.pos()) != 0)
+      return nullptr;  // blob already decref'd by _PyBytes_Resize
+  } else {
+    PyErr_Clear();  // bound allocation failed: geometric growth instead
+    std::vector<uint8_t> out;
+    Py_BEGIN_ALLOW_THREADS;
+    out.reserve((size_t)n * 32);
+    VecWriter w{&out};
+    run_encode(ops, cols, w, n, sizes.data(), &overflow, &vm_err);
+    Py_END_ALLOW_THREADS;
+    Py_DECREF(seq);
+    if (overflow || vm_err) {
+      PyErr_SetString(PyExc_OverflowError,
+                      overflow ? "encoded batch exceeds int32 binary offsets"
+                               : "decimal value does not fit its fixed size");
+      return nullptr;
     }
-    sizes[(size_t)i] = (int32_t)sz;
-    prev = out.size();
+    blob = bytes_from(out.data(), out.size());
+    if (!blob) return nullptr;
   }
-  Py_END_ALLOW_THREADS;
-  Py_DECREF(seq);
-  if (overflow) {
-    PyErr_SetString(PyExc_OverflowError,
-                    "encoded batch exceeds int32 binary offsets");
-    return nullptr;
-  }
-  if (vm_err) {
-    // same error class as the oracle's int.to_bytes overflow
-    PyErr_SetString(PyExc_OverflowError,
-                    "decimal value does not fit its fixed size");
-    return nullptr;
-  }
-  PyObject* blob = bytes_from(out.data(), out.size());
+
   PyObject* szb = bytes_from(sizes.data(), sizes.size() * 4);
-  if (!blob || !szb) {
-    Py_XDECREF(blob);
-    Py_XDECREF(szb);
+  if (!szb) {
+    Py_DECREF(blob);
     return nullptr;
   }
   PyObject* res = Py_BuildValue("(OO)", blob, szb);
