@@ -36,7 +36,7 @@ from typing import List, Optional, Sequence
 
 import pyarrow as pa
 
-from .gate import is_supported
+from .gate import device_supported
 from .ops import UnsupportedOnDevice
 from .fallback.decoder import compile_reader, decode_to_record_batch
 from .fallback.encoder import compile_encoder_plan, encode_record_batch
@@ -67,14 +67,14 @@ def _device_codec(entry: SchemaEntry, backend: str):
         # failed (potentially seconds-long) init on every call. Other
         # schemas still get the device path.
         return None
-    supported = is_supported(entry.ir)
+    supported = device_supported(entry.ir)
     if backend == "auto" and not supported:
         return None
     if not supported:  # backend == "tpu"
         raise ValueError(
-            "schema is outside the TPU fast-path subset "
-            "(bytes/fixed/decimal/uuid/duration/time-* fall back to host); "
-            "use backend='auto' or backend='host'"
+            "schema is outside the device subset (e.g. decimals beyond "
+            "decimal128's 16 bytes / precision 38, or unknown logical "
+            "types on fixed); use backend='auto' or backend='host'"
         )
     try:
         from .ops.codec import get_device_codec

@@ -23,7 +23,7 @@ from .schema.model import (
     Union,
 )
 
-__all__ = ["is_supported", "host_supported"]
+__all__ = ["is_supported", "host_supported", "device_supported"]
 
 _SUPPORTED_LOGICAL = {
     None: ("null", "boolean", "int", "long", "float", "double", "string"),
@@ -93,3 +93,18 @@ def host_supported(t: AvroType) -> bool:
     local-timestamp-* (beyond the reference's fast subset; its fallback
     serves these at Value-tree speed, ``complex.rs``)."""
     return isinstance(t, Record) and _inner(t, _HOST_EXTRA_LOGICAL)
+
+
+def device_supported(t: AvroType) -> bool:
+    """True if the device DECODE walk can handle this top-level schema.
+
+    Same widened surface as the host VM (the reference's full type
+    surface): the extra types ride existing machinery — bytes/uuid/
+    decimal-bytes are string-shaped descriptors on the wire, fixed/
+    duration/decimal-fixed are static-size runs, time-*/local-* are
+    plain int/long wire forms — with the byte→Arrow conversions done in
+    the shared host assembly (``ops/arrow_build.py``). The device
+    ENCODE subset stays the reference fast subset (``ops/encode.py``);
+    the codec serves serialize from the host path for the extras
+    (≙ ``serialize.rs:53-56``'s independent gate)."""
+    return host_supported(t)

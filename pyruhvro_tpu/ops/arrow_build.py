@@ -269,13 +269,68 @@ class _Assembler:
             null_count=nulls,
         )
 
+    def _decimal_raw_from_descriptors(self, t, path, count, valid):
+        """Device-walk layout → 16-byte-LE decimal128 words: gather each
+        row's big-endian two's-complement run from the datum bytes via
+        its ``(start, len)`` descriptor and sign-extend to 16 bytes.
+        Over-long encodings (len > 16, or a fixed size > 16) are legal
+        when the leading bytes are pure sign fill — exactly the values
+        ``int.from_bytes`` accepts in the oracle; anything wider than
+        128 bits necessarily exceeds precision ≤ 38 and raises the
+        oracle's error class. Dead rows (len 0) emit zeros."""
+        live = np.ones(count, bool) if valid is None else valid.astype(bool)
+        starts = np.where(live, self.host[path + "#start"][:count], 0
+                          ).astype(np.int64)
+        if path + "#len" in self.host:
+            lens = np.where(live, self.host[path + "#len"][:count], 0
+                            ).astype(np.int64)
+        else:  # decimal over fixed: static size
+            lens = np.where(live, t.size, 0).astype(np.int64)
+        hi = np.int64(max(len(self.flat) - 1, 0))
+        first = self.flat[np.clip(starts, 0, hi)]
+        fill = np.where(
+            (lens > 0) & ((first & 0x80) != 0), 0xFF, 0
+        ).astype(np.uint8)
+        take = np.minimum(lens, 16)
+        j = np.arange(16)
+        pos = np.clip(starts[:, None] + lens[:, None] - 1 - j, 0, hi)
+        out = np.where(j < take[:, None], self.flat[pos], fill[:, None])
+        over = lens > 16
+        if bool(over.any()):
+            extra = np.where(over, lens - 16, 0)
+            total = int(extra.sum())
+            off = np.zeros(count + 1, np.int64)
+            np.cumsum(extra, out=off[1:])
+            src = np.repeat(starts - off[:-1], extra) + np.arange(
+                total, dtype=np.int64
+            )
+            lead_ok = np.ones(count, bool)
+            np.logical_and.at(
+                lead_ok,
+                np.repeat(np.arange(count), extra),
+                self.flat[np.clip(src, 0, hi)] == np.repeat(fill, extra),
+            )
+            sign_ok = ((out[:, 15] & 0x80) != 0) == (fill == 0xFF)
+            bad = over & ~(lead_ok & sign_ok)
+            if bool(bad.any()):
+                i = int(np.flatnonzero(bad)[0])
+                raise pa.lib.ArrowInvalid(
+                    f"decimal at {path!r} row {i} exceeds precision "
+                    f"{t.precision}"
+                )
+        return np.ascontiguousarray(out.astype(np.uint8).reshape(-1))
+
     def _decimal(self, t, dt, path, count, vbuf, nulls, valid):
-        """Decimal128 from the host VM's 16-byte-LE #dec words (the
-        exact Arrow decimal128 buffer layout), validating live values
-        against the declared precision — the oracle's ``pa.array``
+        """Decimal128 from either layout — the host VM's ready 16-byte-LE
+        ``#dec`` words, or the device walk's ``(start, len)`` descriptors
+        (``_decimal_raw_from_descriptors``) — validating live values
+        against the declared precision; the oracle's ``pa.array``
         raises ArrowInvalid for over-precision values, and
         ``from_buffers`` would silently accept them."""
-        raw = np.ascontiguousarray(self.host[path + "#dec"][: count * 16])
+        if path + "#dec" in self.host:
+            raw = np.ascontiguousarray(self.host[path + "#dec"][: count * 16])
+        else:
+            raw = self._decimal_raw_from_descriptors(t, path, count, valid)
         if count:
             words = raw.view(np.uint64).reshape(count, 2)
             lo, hi = words[:, 0], words[:, 1]
@@ -310,7 +365,21 @@ class _Assembler:
         vbuf, nulls = _validity(valid, count)
         if t.logical == "decimal":
             return self._decimal(t, dt, path, count, vbuf, nulls, valid)
-        raw = self.host[path + "#fix"][: count * t.size]
+        if path + "#fix" in self.host:
+            raw = self.host[path + "#fix"][: count * t.size]
+        else:
+            # device-walk layout: gather the static-size run per row from
+            # the datum bytes; dead rows (null/non-selected arm) → zeros
+            # like the host VM's builder
+            live = (
+                np.ones(count, bool) if valid is None else valid.astype(bool)
+            )
+            starts = self.host[path + "#start"][:count].astype(np.int64)
+            hi = np.int64(max(len(self.flat) - 1, 0))
+            pos = np.clip(starts[:, None] + np.arange(t.size), 0, hi)
+            raw = np.where(
+                live[:, None], self.flat[pos], np.uint8(0)
+            ).astype(np.uint8).reshape(-1)
         if t.logical == "duration":
             u = np.ascontiguousarray(raw).view(np.uint32).reshape(count, 3)
             # uint64 holds the wire maximum ((2^32·30 + 2^32)·86400000 +

@@ -280,6 +280,26 @@ class DeviceCodec:
             ])
 
     def _host_encode(self, batch: pa.RecordBatch) -> pa.Array:
+        """Host-path encode for schemas/batches the device encoder hands
+        back: the native VM when available (mirrors ``_host_decode`` —
+        the widened device-decode subset routes schemas here whose
+        serialize previously never built a codec, and they must keep
+        their native-VM speed), else the Python fallback encoder."""
+        from ..api import _native_host_codec
+
+        native = _native_host_codec(self.entry)
+        if native is not None:
+            from .decode import BatchTooLarge as _BTL
+
+            try:
+                return native.encode(batch)
+            except _BTL:
+                if batch.num_rows >= 2:
+                    mid = batch.num_rows // 2
+                    return pa.concat_arrays([
+                        self._host_encode(batch.slice(0, mid)),
+                        self._host_encode(batch.slice(mid)),
+                    ])
         from ..fallback.encoder import (
             compile_encoder_plan,
             encode_record_batch,

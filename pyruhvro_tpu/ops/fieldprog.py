@@ -56,11 +56,12 @@ from .varint import (
     read_varint64,
     zigzag_decode_pair,
 )
-from ..gate import is_supported
+from ..gate import device_supported
 from ..schema.model import (
     Array,
     AvroType,
     Enum,
+    Fixed,
     Map,
     Primitive,
     Record,
@@ -158,6 +159,8 @@ class _Lowering:
         ``t`` at ``path``, registering its output buffers."""
         if isinstance(t, Primitive):
             return self.lower_primitive(t, path, region)
+        if isinstance(t, Fixed):
+            return self.lower_fixed(t, path, region)
         if isinstance(t, Enum):
             return self.lower_enum(t, path, region)
         if isinstance(t, Record):
@@ -230,7 +233,12 @@ class _Lowering:
 
             return emit_bool
 
-        if name == "string":
+        if name in ("string", "bytes"):
+            # one wire form, three Arrow destinations: Utf8 (string,
+            # incl. uuid text), Binary (bytes), Decimal128 (decimal over
+            # bytes). The walk only records (start, len) descriptors;
+            # the shared host assembly does the per-type conversion
+            # (``arrow_build._string_values`` / ``._decimal`` / ``._uuid``)
             self.buf(path + "#start", I32, region)
             self.buf(path + "#len", I32, region)
             self.string_cols.append(StringCol(path, region))
@@ -253,6 +261,24 @@ class _Lowering:
             return emit_string
 
         raise UnsupportedOnDevice(f"primitive {name!r} at {path!r}")
+
+    def lower_fixed(self, t: Fixed, path: str, region: int) -> Callable:
+        """Avro ``fixed`` (incl. duration = fixed(12) and decimal over
+        fixed): a static-size byte run — the walk records the start only
+        (the length is the schema constant) and the host assembly gathers
+        + converts (``arrow_build._fixed`` / ``._decimal``)."""
+        self.buf(path + "#start", I32, region)
+        size = t.size
+
+        def emit_fixed(cx, st, mask, out_idx):
+            cur = st["#cursor"]
+            new_cur = cur + jnp.where(mask, I32(size), 0)
+            st = _err_where(st, mask & (new_cur > cx.ends), ERR_OVERRUN)
+            st = _put(st, path + "#start", out_idx, cur, mask)
+            st["#cursor"] = new_cur
+            return st
+
+        return emit_fixed
 
     def lower_enum(self, t: Enum, path: str, region: int) -> Callable:
         self.buf(path + "#v", I32, region)
@@ -457,13 +483,16 @@ class _Lowering:
 def lower(ir: AvroType) -> Program:
     """Lower a top-level record schema to its device field program.
 
-    Raises :class:`UnsupportedOnDevice` when outside the device subset
-    (which is the reference's fast subset, ``fast_decode.rs:38-61``,
-    nested repetition included — ``lower_repeated`` recurses, with the
-    inner region's strided slots indexed by the outer item's slot).
+    Raises :class:`UnsupportedOnDevice` when outside the device subset —
+    a strict SUPERSET of the reference's fast subset
+    (``fast_decode.rs:38-61``): the full reference type surface,
+    including bytes/fixed/decimal/uuid/duration/time-* which the
+    reference serves only via its Value-tree fallback. Nested repetition
+    included — ``lower_repeated`` recurses, with the inner region's
+    strided slots indexed by the outer item's slot.
     """
-    if not is_supported(ir):
-        raise UnsupportedOnDevice("schema is outside the fast-path subset")
+    if not device_supported(ir):
+        raise UnsupportedOnDevice("schema is outside the device subset")
     lo = _Lowering()
     emit = lo.lower_record(ir, "", ROWS)
     return Program(

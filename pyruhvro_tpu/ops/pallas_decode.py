@@ -1,0 +1,276 @@
+"""Pallas TPU walk kernel: the field program executed from VMEM tiles.
+
+The XLA pipeline (``ops/decode.py``) runs the lowered field program
+(``ops/fieldprog.py``) as one traced XLA computation whose byte reads are
+gathers into the flat HBM word buffer. This module runs the **same
+program** — same lowering, same emitters, same error bits — inside a
+``pl.pallas_call`` kernel (SURVEY.md §7 step 4's "Pallas kernel: one
+record per grid element"; ≙ the hot loop being replaced,
+``ruhvro/src/fast_decode.rs:806-834``):
+
+* records are packed **row-padded** ``[R, BW]`` little-endian u32 words
+  (one row per record, ``BW`` = bucketed max record words) instead of the
+  flat+offsets layout, so one grid step's tile ``[TILE_R, BW]`` is a
+  contiguous VMEM block,
+* per-lane cursors are **record-local** byte positions; the word source
+  handed to the shared readers resolves ``take_words(widx)`` as a
+  clip-clamped **select chain over the tile's static columns** — pure
+  VPU ALU on VMEM-resident data, no gather, no reshape, nothing Mosaic
+  struggles to lower,
+* outputs are the program's row-region buffers, blocked ``[TILE_R]`` per
+  grid step (u8 lanes widened to i32 in-kernel, cast back outside);
+  string ``#start`` descriptors are rebased to global byte offsets into
+  the row-major padded buffer so the host finalize (``arrow_build``)
+  gathers value bytes exactly like the XLA path.
+
+Scope (v1): schemas whose field program has **no repeated regions**
+(array/map) — those need the block-protocol ``while_loop`` + strided
+scatters, which stay on the XLA pipeline (``fast_decode.rs:689-786``'s
+territory). The gate mirrors ``deserialize.rs:26-29``: callers fall back
+transparently.
+
+``interpret=True`` runs the kernel on CPU for the differential suite;
+on hardware the same call compiles via Mosaic.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Sequence, Tuple
+
+import numpy as np
+
+from ..fallback.io import MalformedAvro
+from ..runtime import metrics
+from ..runtime.pack import bucket_len, concat_records
+from . import UnsupportedOnDevice
+from .fieldprog import ROWS, Program, _Ctx, lower
+from .varint import ERR_NAMES, ERR_TRAILING
+
+__all__ = ["PallasKernelDecoder", "pallas_supported"]
+
+_LANE = 128           # TPU lane width; TILE_R is always a multiple
+_VMEM_TILE_BYTES = 1 << 21  # ~2 MiB tile budget (VMEM is ~16 MiB/core)
+_MAX_BW = 512         # beyond 2 KiB/record the select chain is silly;
+                      # such batches stay on the XLA pipeline
+
+
+def pallas_supported(prog: Program) -> bool:
+    """Can this lowered program run as the Pallas walk kernel (v1)?"""
+    return len(prog.regions) == 1
+
+
+class _TileWords:
+    """Word source over a ``[TILE_R, BW]`` VMEM tile: lane ``l`` reads
+    word ``widx[l]`` of ITS OWN row via a clip-clamped select chain over
+    the ``BW`` static columns (see module docstring)."""
+
+    def __init__(self, tile, jnp):
+        self._tile = tile
+        self._jnp = jnp
+
+    def take_words(self, widx):
+        jnp = self._jnp
+        bw = self._tile.shape[1]
+        w = jnp.clip(widx, 0, bw - 1)
+        acc = self._tile[:, 0]
+        for k in range(1, bw):
+            acc = jnp.where(w == k, self._tile[:, k], acc)
+        return acc
+
+
+class PallasKernelDecoder:
+    """Per-schema Pallas decode kernel (flat-schema subset).
+
+    Same public contract as :class:`ops.decode.DeviceDecoder`'s
+    ``decode_to_columns`` (host column dict + meta), so the Arrow
+    assembly and the differential tests are shared verbatim.
+    """
+
+    def __init__(self, ir, interpret: bool = False):
+        import jax  # deferred, like the rest of the package
+
+        self._jax = jax
+        self.prog = lower(ir)
+        if not pallas_supported(self.prog):
+            raise UnsupportedOnDevice(
+                "pallas walk kernel v1 covers schemas without array/map "
+                "(repeated regions run on the XLA pipeline)"
+            )
+        self.interpret = interpret
+        self._cache: Dict[Tuple[int, int, int], object] = {}
+        self._lock = threading.Lock()
+        # sorted row-region output keys define the output tuple order
+        self.out_keys = sorted(self.prog.buffers) + ["#err"]
+        self._widened = {
+            k: self.prog.buffers[k].dtype for k in sorted(self.prog.buffers)
+        }
+
+    # -- kernel construction ------------------------------------------------
+
+    def _tile_rows(self, BW: int) -> int:
+        rows = _VMEM_TILE_BYTES // (BW * 4)
+        rows = max(_LANE, min(1024, (rows // _LANE) * _LANE))
+        return rows
+
+    def _build(self, grid_r: int, tile_r: int, BW: int):
+        """One compiled pallas_call for a (grid, TILE_R, BW) bucket."""
+        jax = self._jax
+        jnp = jax.numpy
+        from jax.experimental import pallas as pl
+
+        prog = self.prog
+        out_keys = self.out_keys
+        widened = self._widened
+        # every descriptor start must rebase to a global offset into the
+        # row-major padded buffer: string/bytes/decimal-bytes descriptors
+        # AND the fixed-family's static-run starts (all end in "#start")
+        start_keys = [k for k in prog.buffers if k.endswith("#start")]
+
+        def kernel(words_ref, lens_ref, act_ref, *out_refs):
+            tile = words_ref[...]                      # [TILE_R, BW] u32
+            lens = lens_ref[...]                       # [TILE_R] i32
+            active = act_ref[...] != 0
+            cursors = jnp.zeros_like(lens)             # record-local bytes
+            st = {"#cursor": cursors, "#err": jnp.zeros_like(lens).astype(jnp.uint32)}
+            for key in sorted(prog.buffers):
+                dt = widened[key]
+                kdt = jnp.int32 if jnp.dtype(dt) == jnp.uint8 else dt
+                st[key] = jnp.zeros(tile_r, kdt)
+            cx = _Ctx(_TileWords(tile, jnp), lens, item_caps=(0,))
+            st = prog.emit(cx, st, active, None)
+            st["#err"] = st["#err"] | jnp.where(
+                active & (st["#cursor"] != lens),
+                jnp.uint32(ERR_TRAILING),
+                jnp.uint32(0),
+            )
+            # rebase descriptor starts: record-local -> global byte offset
+            # in the row-major [R, BW*4] padded buffer the host gathers
+            # from (the caller guards R * BW * 4 against int32)
+            if start_keys:
+                lane = jax.lax.broadcasted_iota(
+                    jnp.int32, (tile_r, 1), 0
+                ).squeeze(-1)
+                row = pl.program_id(0) * tile_r + lane
+                for k in start_keys:
+                    st[k] = jnp.where(active, st[k] + row * (BW * 4), 0)
+            for i, key in enumerate(out_keys):
+                v = st[key]
+                if v.dtype == jnp.uint8:  # defensive; state is widened
+                    v = v.astype(jnp.int32)
+                out_refs[i][...] = v
+
+        out_shapes = []
+        out_specs = []
+        for key in out_keys:
+            dt = jnp.uint32 if key == "#err" else widened[key]
+            if jnp.dtype(dt) == jnp.uint8:
+                dt = jnp.int32  # widened in-kernel, cast back outside
+            out_shapes.append(
+                jax.ShapeDtypeStruct((grid_r * tile_r,), dt)
+            )
+            out_specs.append(pl.BlockSpec((tile_r,), lambda i: (i,)))
+
+        call = pl.pallas_call(
+            kernel,
+            grid=(grid_r,),
+            in_specs=[
+                pl.BlockSpec((tile_r, BW), lambda i: (i, 0)),
+                pl.BlockSpec((tile_r,), lambda i: (i,)),
+                pl.BlockSpec((tile_r,), lambda i: (i,)),
+            ],
+            out_specs=out_specs,
+            out_shape=out_shapes,
+            interpret=self.interpret,
+        )
+
+        def fn(words2d, lens, act):
+            outs = call(words2d, lens, act)
+            res = []
+            for key, v in zip(out_keys, outs):
+                want = jnp.uint32 if key == "#err" else widened[key]
+                res.append(v.astype(want))
+            return tuple(res)
+
+        return jax.jit(fn)
+
+    def _fn(self, grid_r: int, tile_r: int, BW: int):
+        key = (grid_r, tile_r, BW)
+        fn = self._cache.get(key)
+        if fn is None:
+            fn = self._build(grid_r, tile_r, BW)
+            with self._lock:
+                self._cache[key] = fn
+        return fn
+
+    # -- host orchestration ---------------------------------------------------
+
+    def decode_to_columns(self, data: Sequence[bytes]):
+        """Row-padded pack → kernel → host columns (same contract as
+        ``DeviceDecoder.decode_to_columns``)."""
+        jax = self._jax
+        n = len(data)
+        with metrics.timer("decode.pack_s"):
+            flat, offsets = concat_records(data)
+        lens_np = np.diff(offsets).astype(np.int32)
+        max_b = int(lens_np.max(initial=1))
+        BW = bucket_len(max(-(-max_b // 4), 1), minimum=4)
+        if BW > _MAX_BW:
+            raise UnsupportedOnDevice(
+                f"record of {max_b} bytes exceeds the pallas tile budget"
+            )
+        tile_r = self._tile_rows(BW)
+        grid_r = max(1, -(-n // tile_r))
+        R = grid_r * tile_r
+        if R * (BW * 4) > (1 << 30):
+            # descriptor starts rebase to int32 global offsets, and row
+            # padding amplifies skewed batches (R × max record size);
+            # same 1 GiB launch budget as the XLA pipeline — callers
+            # split or take the XLA path
+            from .decode import BatchTooLarge
+
+            raise BatchTooLarge(n, R * BW * 4)
+
+        # row-padded layout: record i's bytes at [i, 0:len_i], built by
+        # one vectorized scatter of the packed run
+        padded = np.zeros((R, BW * 4), np.uint8)
+        total = int(offsets[-1])
+        rows = np.repeat(np.arange(n), lens_np)
+        cols = np.arange(total, dtype=np.int64) - np.repeat(
+            offsets[:-1].astype(np.int64), lens_np
+        )
+        padded[rows, cols] = flat[:total]
+        words2d = padded.view(np.uint32)
+        lens = np.zeros(R, np.int32)
+        lens[:n] = lens_np
+        act = np.zeros(R, np.int32)
+        act[:n] = 1
+
+        fn = self._fn(grid_r, tile_r, BW)
+        with metrics.timer("decode.h2d_s"):
+            args = (jax.device_put(words2d), jax.device_put(lens),
+                    jax.device_put(act))
+        metrics.inc("decode.h2d_bytes", words2d.nbytes + lens.nbytes + act.nbytes)
+        with metrics.timer("decode.launch_s"):
+            outs = fn(*args)
+        with metrics.timer("decode.d2h_s"):
+            outs = [np.asarray(jax.device_get(v)) for v in outs]
+        metrics.inc("decode.d2h_bytes", sum(v.nbytes for v in outs))
+
+        host = dict(zip(self.out_keys, outs))
+        err = host.pop("#err")[:n]
+        if err.any():
+            i = int(np.flatnonzero(err)[0])
+            bit = int(err[i]) & -int(err[i])
+            raise MalformedAvro(
+                f"record {i}: {ERR_NAMES.get(bit, f'error bit {bit:#x}')}"
+            )
+        meta = {"item_totals": {}, "flat": padded.reshape(-1)}
+        return host, n, meta
+
+    def decode(self, data: Sequence[bytes], arrow_schema):
+        """Straight to a RecordBatch (test/bench convenience)."""
+        from .arrow_build import build_record_batch
+
+        host, n, meta = self.decode_to_columns(data)
+        return build_record_batch(self.prog.ir, arrow_schema, host, n, meta)

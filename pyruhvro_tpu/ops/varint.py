@@ -73,13 +73,27 @@ ERR_NAMES = {
 }
 
 
-def get_byte(words: jnp.ndarray, idx: jnp.ndarray) -> jnp.ndarray:
+def _take_words(words, widx):
+    """One word per lane at word index ``widx`` (clip semantics).
+
+    ``words`` is either a flat u32 array (XLA pipeline: one gather) or
+    any object exposing ``take_words(widx)`` — the seam that lets the
+    SAME field program run inside a Pallas kernel, where the word source
+    is a VMEM-resident record tile read without a gather
+    (``ops/pallas_decode.py``)."""
+    take = getattr(words, "take_words", None)
+    if take is not None:
+        return take(widx)
+    return jnp.take(words, widx, mode="clip")
+
+
+def get_byte(words, idx: jnp.ndarray) -> jnp.ndarray:
     """Byte ``idx`` of the little-endian u32-word buffer, as uint32 lanes.
 
     Out-of-range indices clip to the last word (callers mask the result);
     negative clip to 0.
     """
-    w = jnp.take(words, lax.shift_right_logical(idx, 2), mode="clip")
+    w = _take_words(words, lax.shift_right_logical(idx, 2))
     shift = (jnp.bitwise_and(idx, 3) << 3).astype(U32)
     return jnp.bitwise_and(lax.shift_right_logical(w, shift), U32(0xFF))
 
@@ -92,7 +106,7 @@ def load_window(words, cursor, nwords: int):
     time and TPU issue rate (the VPU moves 32-bit lanes, never bytes).
     """
     wbase = lax.shift_right_logical(cursor, 2)
-    win = [jnp.take(words, wbase + k, mode="clip") for k in range(nwords)]
+    win = [_take_words(words, wbase + k) for k in range(nwords)]
     a = (jnp.bitwise_and(cursor, 3) << 3).astype(U32)  # bit offset 0/8/16/24
     nz = a != U32(0)
     inv = (U32(32) - a) & U32(31)

@@ -1,0 +1,86 @@
+#!/usr/bin/env python
+"""A/B the decode walk: XLA pipeline vs the Pallas kernel, on-device.
+
+Runs the flat-shape schemas through both device decode paths
+(``ops/decode.DeviceDecoder`` and ``ops/pallas_decode.PallasKernelDecoder``)
+on whatever backend JAX resolves, checks both against the pure-Python
+oracle, and reports wall/launch timing. On a co-located chip this
+isolates in-kernel time; through a high-latency tunnel the transport
+dominates both (BENCH_NOTES.md) — the oracle equality check is then the
+main signal.
+
+Usage: python scripts/ab_pallas.py [--rows 10000] [--interpret]
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--rows", type=int, default=10_000)
+    ap.add_argument("--reps", type=int, default=3)
+    ap.add_argument("--interpret", action="store_true",
+                    help="run the pallas kernel in interpreter mode (CPU)")
+    args = ap.parse_args()
+
+    import jax
+
+    print(f"devices: {jax.devices()}", file=sys.stderr)
+
+    from pyruhvro_tpu.fallback.decoder import decode_to_record_batch
+    from pyruhvro_tpu.ops.decode import DeviceDecoder
+    from pyruhvro_tpu.ops.pallas_decode import PallasKernelDecoder
+    from pyruhvro_tpu.ops.arrow_build import build_record_batch
+    from pyruhvro_tpu.schema.arrow_map import to_arrow_schema
+    from pyruhvro_tpu.schema.parser import parse_schema
+    from pyruhvro_tpu.utils.datagen import CRITERION_SHAPES, random_datums
+
+    for shape in ("flat_primitives", "nullable_primitives", "nested_struct"):
+        schema = CRITERION_SHAPES[shape]
+        ir = parse_schema(schema)
+        arrow = to_arrow_schema(ir)
+        datums = random_datums(ir, args.rows, seed=11)
+        want = decode_to_record_batch(datums, ir, arrow)
+
+        def run_xla():
+            d = DeviceDecoder(ir)
+            host, n, meta = d.decode_to_columns(datums)
+            return build_record_batch(ir, arrow, host, n, meta)
+
+        def run_pallas():
+            d = PallasKernelDecoder(ir, interpret=args.interpret)
+            host, n, meta = d.decode_to_columns(datums)
+            return build_record_batch(ir, arrow, host, n, meta)
+
+        for name, fn in (("xla", run_xla), ("pallas", run_pallas)):
+            try:
+                t0 = time.perf_counter()
+                got = fn()  # includes compile
+                compile_and_first = time.perf_counter() - t0
+                ok = got.equals(want)
+                best = float("inf")
+                for _ in range(args.reps):
+                    t0 = time.perf_counter()
+                    fn()
+                    best = min(best, time.perf_counter() - t0)
+                print(
+                    f"{shape:22s} {name:7s} rows={args.rows} "
+                    f"first={compile_and_first * 1e3:8.1f}ms "
+                    f"best={best * 1e3:8.1f}ms "
+                    f"({args.rows / best:,.0f} rec/s) oracle={'OK' if ok else 'MISMATCH'}",
+                    flush=True,
+                )
+                if not ok:
+                    sys.exit(2)
+            except Exception as e:  # noqa: BLE001 — report and continue
+                print(f"{shape:22s} {name:7s} FAILED: {e!r}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -87,8 +87,17 @@ def test_unsupported_schema_silently_falls_back():
 
 
 def test_backend_tpu_rejects_unsupported_schema():
-    with pytest.raises(ValueError, match="outside the TPU fast-path subset"):
-        pv.deserialize_array([b"\x00"], UNSUPPORTED_SCHEMA, backend="tpu")
+    # the device subset now covers the FULL reference type surface
+    # (bytes included — see tests/test_device_widened.py); the one
+    # remaining exclusion is fixed decimals wider than decimal128
+    wide_dec = json.dumps({
+        "type": "record", "name": "W",
+        "fields": [{"name": "d", "type": {
+            "type": "fixed", "name": "F20", "size": 20,
+            "logicalType": "decimal", "precision": 44, "scale": 2}}],
+    })
+    with pytest.raises(ValueError, match="outside the device subset"):
+        pv.deserialize_array([b"\x00" * 20], wide_dec, backend="tpu")
 
 
 def test_backend_validation():
