@@ -114,14 +114,10 @@ class NativeHostCodec:
                 path = key[: -len("#offsets")]
                 bufs.append(ex.arrays[path + "#count"][0])
             elif ctype in (COL_I64, COL_F64):
-                # the shared extractor splits 64-bit values into u32
-                # halves for the device; the VM wants them whole
-                base = key[: -len("#v64")] + "#v"
-                lo = ex.arrays[base + ":lo"][0].astype(np.uint64)
-                hi = ex.arrays[base + ":hi"][0].astype(np.uint64)
-                whole = (hi << np.uint64(32)) | lo
-                view = np.int64 if ctype == COL_I64 else np.float64
-                bufs.append(np.ascontiguousarray(whole.view(view)))
+                # host_mode extraction emits whole #v64 arrays (no u32
+                # lane split); a KeyError here means a device-mode
+                # extract was passed in — encode() always uses host_mode
+                bufs.append(ex.arrays[key][0])
             else:  # #v / #valid / #tid — same keys both sides
                 bufs.append(ex.arrays[key][0])
         return bufs
@@ -139,7 +135,7 @@ class NativeHostCodec:
         if n == 0:
             return pa.array([], pa.binary())
         with metrics.timer("host.extract_s"):
-            ex = run_extractor(self.ir, batch)
+            ex = run_extractor(self.ir, batch, host_mode=True)
             bufs = self._encode_buffers(ex)
         # the extractor's bound is a STRICT upper bound on the wire
         # total (loose: 10 B/long regardless of varint width), which

@@ -535,12 +535,20 @@ class _Extractor:
     union arm is never encoded, so never an error; same as the oracle,
     which never visits masked values)."""
 
-    def __init__(self) -> None:
+    def __init__(self, host_mode: bool = False) -> None:
         self.arrays: Dict[str, Tuple[np.ndarray, int]] = {}  # key → (arr, region)
         self.byte_bufs: Dict[str, np.ndarray] = {}           # key → u8 buffer
         self.region_len: Dict[int, int] = {}
         self.regions: List[str] = [""]
         self.bound = 0
+        # host_mode: produce the native VM's input layout — whole int64/
+        # float64 ``#v64`` arrays (no u32 lane split) read zero-copy off
+        # the Arrow values buffers, with NO fill_null materialization:
+        # the VM consumes-but-never-emits dead entries, so whatever bytes
+        # a null slot holds are fine (Arrow defines the buffer exists,
+        # not its content there). Device mode keeps defined zeros — the
+        # vectorized size pass reads every lane before masking.
+        self.host_mode = host_mode
 
     def put(self, key: str, arr: np.ndarray, region: int) -> None:
         self.arrays[key] = (np.ascontiguousarray(arr), region)
@@ -551,7 +559,36 @@ class _Extractor:
     def _valid(arr: pa.Array) -> Optional[np.ndarray]:
         if arr.null_count == 0:
             return None
-        return arr.is_valid().to_numpy(zero_copy_only=False)
+        vbuf = arr.buffers()[0]
+        if vbuf is None:  # null_count > 0 without a bitmap: NullArray etc.
+            return arr.is_valid().to_numpy(zero_copy_only=False)
+        n = len(arr)
+        bits = np.frombuffer(
+            vbuf, np.uint8, count=(arr.offset + n + 7) // 8
+        )
+        return np.unpackbits(bits, bitorder="little")[
+            arr.offset : arr.offset + n
+        ].astype(bool)
+
+    @staticmethod
+    def _raw_fixed_width(arr: pa.Array, np_dtype) -> Optional[np.ndarray]:
+        """Zero-copy view of a fixed-width values buffer when the Arrow
+        physical layout matches ``np_dtype``'s width (int32/date32,
+        int64/timestamp/time64, float32, float64 — NOT boolean, whose
+        values are bit-packed). None → caller takes the cast path."""
+        t = arr.type
+        try:
+            w = t.byte_width
+        except (ValueError, AttributeError):
+            return None
+        if w != np.dtype(np_dtype).itemsize or pa.types.is_boolean(t):
+            return None
+        buf = arr.buffers()[1]
+        if buf is None:
+            return np.zeros(len(arr), np_dtype)
+        return np.frombuffer(
+            buf, np_dtype, count=len(arr) + arr.offset
+        )[arr.offset:]
 
     @staticmethod
     def _ints(arr: pa.Array, target: pa.DataType, dtype) -> np.ndarray:
@@ -690,34 +727,50 @@ class _Extractor:
         if name == "null":
             return
         if name == "int":
-            self.put(path + "#v", self._ints(arr, pa.int32(), np.int32), region)
-            self.bound += 5 * len(arr)
-        elif name == "long":
-            v = self._ints(arr, pa.int64(), np.int64)
-            u = v.view(np.uint64)
-            self.put(path + "#v:lo", (u & 0xFFFFFFFF).astype(np.uint32), region)
-            self.put(path + "#v:hi", (u >> 32).astype(np.uint32), region)
-            self.bound += 10 * len(arr)
-        elif name == "float":
-            import pyarrow.compute as pc
-
-            a = pc.fill_null(arr, 0.0) if arr.null_count else arr
+            raw = self._raw_fixed_width(arr, np.int32) if self.host_mode else None
             self.put(
                 path + "#v",
-                a.to_numpy(zero_copy_only=False).astype(np.float32,
-                                                        copy=False),
+                raw if raw is not None
+                else self._ints(arr, pa.int32(), np.int32),
                 region,
             )
+            self.bound += 5 * len(arr)
+        elif name == "long":
+            raw = self._raw_fixed_width(arr, np.int64) if self.host_mode else None
+            v = raw if raw is not None else self._ints(arr, pa.int64(), np.int64)
+            if self.host_mode:
+                self.put(path + "#v64", v, region)
+            else:
+                u = v.view(np.uint64)
+                self.put(path + "#v:lo", (u & 0xFFFFFFFF).astype(np.uint32), region)
+                self.put(path + "#v:hi", (u >> 32).astype(np.uint32), region)
+            self.bound += 10 * len(arr)
+        elif name == "float":
+            raw = self._raw_fixed_width(arr, np.float32) if self.host_mode else None
+            if raw is None:
+                import pyarrow.compute as pc
+
+                a = pc.fill_null(arr, 0.0) if arr.null_count else arr
+                raw = a.to_numpy(zero_copy_only=False).astype(
+                    np.float32, copy=False
+                )
+            self.put(path + "#v", raw, region)
             self.bound += 4 * len(arr)
         elif name == "double":
-            import pyarrow.compute as pc
+            raw = self._raw_fixed_width(arr, np.float64) if self.host_mode else None
+            if raw is None:
+                import pyarrow.compute as pc
 
-            a = pc.fill_null(arr, 0.0) if arr.null_count else arr
-            u = a.to_numpy(zero_copy_only=False).astype(
-                np.float64, copy=False
-            ).view(np.uint64)
-            self.put(path + "#v:lo", (u & 0xFFFFFFFF).astype(np.uint32), region)
-            self.put(path + "#v:hi", (u >> 32).astype(np.uint32), region)
+                a = pc.fill_null(arr, 0.0) if arr.null_count else arr
+                raw = a.to_numpy(zero_copy_only=False).astype(
+                    np.float64, copy=False
+                )
+            if self.host_mode:
+                self.put(path + "#v64", raw, region)
+            else:
+                u = raw.view(np.uint64)
+                self.put(path + "#v:lo", (u & 0xFFFFFFFF).astype(np.uint32), region)
+                self.put(path + "#v:hi", (u >> 32).astype(np.uint32), region)
             self.bound += 8 * len(arr)
         elif name == "boolean":
             self.put(path + "#v", self._ints(arr, pa.uint8(), np.uint8), region)
@@ -872,7 +925,8 @@ class _Extractor:
             self.extract(t.values, vals, path + "/@val", rid, item_parent)
 
 
-def run_extractor(ir: Record, batch: pa.RecordBatch) -> "_Extractor":
+def run_extractor(ir: Record, batch: pa.RecordBatch,
+                  host_mode: bool = False) -> "_Extractor":
     """Column-match an Arrow batch against the schema and walk it into
     per-path numpy arrays (shared by the device encoder and the native
     host encoder). Columns are matched by NAME (missing → error, extras
@@ -881,7 +935,7 @@ def run_extractor(ir: Record, batch: pa.RecordBatch) -> "_Extractor":
     from ..fallback.encoder import _types_compatible
     from ..schema.arrow_map import to_arrow_field
 
-    ex = _Extractor()
+    ex = _Extractor(host_mode)
     cols = []
     for f in ir.fields:
         idx = batch.schema.get_field_index(f.name)
