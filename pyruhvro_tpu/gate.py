@@ -104,7 +104,8 @@ def device_supported(t: AvroType) -> bool:
     duration/decimal-fixed are static-size runs, time-*/local-* are
     plain int/long wire forms — with the byte→Arrow conversions done in
     the shared host assembly (``ops/arrow_build.py``). The device
-    ENCODE subset stays the reference fast subset (``ops/encode.py``);
-    the codec serves serialize from the host path for the extras
-    (≙ ``serialize.rs:53-56``'s independent gate)."""
+    ENCODE program covers the same widened surface (``lower_encoder``,
+    ``ops/encode.py``: fixed runs ride the bulk payload scatter,
+    decimals get host-computed ``#dlen`` byte lengths), so both
+    directions gate identically."""
     return host_supported(t)

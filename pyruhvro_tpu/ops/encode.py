@@ -43,7 +43,7 @@ from jax import lax
 
 from . import UnsupportedOnDevice
 from .fieldprog import ROWS, _BIG
-from ..gate import is_supported
+from ..gate import device_supported
 from ..runtime.pack import bucket_len
 from ..schema.model import (
     Array,
@@ -170,6 +170,10 @@ class _EncLowering:
         mask)`` scatters the value bytes at per-lane cursors."""
         if isinstance(t, Primitive):
             return self.lower_primitive(t, path, region)
+        if isinstance(t, Fixed):
+            if t.logical == "decimal":
+                return self.lower_decimal(path, region, fixed_size=t.size)
+            return self.lower_fixed(t, path, region)
         if isinstance(t, Enum):
             return self.lower_varint_leaf(path + "#v", path, wide=False)
         if isinstance(t, Record):
@@ -258,7 +262,13 @@ class _EncLowering:
 
             return size_b, write_b
 
-        if name == "string":
+        if name == "bytes" and t.logical == "decimal":
+            return self.lower_decimal(path, region, fixed_size=None)
+
+        if name in ("string", "bytes"):
+            # Binary shares Utf8's wire form (len varint + payload);
+            # uuid arrives from the extractor already rendered as
+            # canonical text in the same column layout
             self.string_cols.append(_StrCol(path, region))
 
             def size_s(cx):
@@ -280,6 +290,85 @@ class _EncLowering:
             return size_s, write_s
 
         raise UnsupportedOnDevice(f"primitive {name!r} at {path!r}")
+
+    def lower_fixed(self, t: Fixed, path: str, region: int):
+        """Plain ``fixed`` (incl. duration, pre-converted to its wire
+        12 bytes by the extractor): a constant-size raw run. Rides the
+        bulk payload scatter exactly like strings — the extractor emits
+        the same ``#src``/``#len``/``#bytes`` column layout with
+        constant lens, so no per-byte unrolled writes are needed
+        (size-independent compile)."""
+        self.string_cols.append(_StrCol(path, region))
+        size_c = t.size
+
+        def size(cx):
+            return jnp.full(cx.dv[path + "#len"].shape, size_c, I32)
+
+        def write(cx, cursor, mask):
+            cx.str_dst[path] = (cursor, mask)
+
+        return size, write
+
+    def lower_decimal(self, path: str, region: int,
+                      fixed_size: Optional[int]):
+        """Decimal over bytes (minimal-length big-endian two's
+        complement, length varint prefix) or over fixed (constant
+        size). The byte LENGTH per entry is data-dependent but cheap —
+        the extractor computes it host-side, vectorized, as ``#dlen``
+        (≙ the oracle's ``max((bits + 8) // 8, 1)``); the device writes
+        the BE bytes by reversing the 16-byte-LE ``#dec`` words, with
+        sign fill past byte 16 (n = 17 happens at the int128 minimum)."""
+
+        def n_of(cx):
+            if fixed_size is not None:
+                return jnp.full(cx.dv["#active:%d" % region].shape,
+                                fixed_size, I32)
+            return cx.dv[path + "#dlen"]
+
+        def write_bytes(cx, at, mask, n):
+            dec = cx.dv[path + "#dec"]
+            ent = jnp.arange(n.shape[0], dtype=I32) * 16
+            msb = jnp.take(dec, ent + 15, mode="clip").astype(U32)
+            fill = jnp.where(msb >= U32(0x80), U32(0xFF), U32(0))
+            kmax = 17 if fixed_size is None else fixed_size
+            for k in range(kmax):
+                le = n - 1 - k
+                in16 = (le >= 0) & (le < 16)
+                b = jnp.where(
+                    in16,
+                    jnp.take(
+                        dec, ent + jnp.clip(le, 0, 15), mode="clip"
+                    ).astype(U32),
+                    fill,
+                )
+                cx.out = _put_byte(cx.out, at + k, b, mask & (k < n))
+
+        if fixed_size is not None:
+
+            def size(cx):
+                return n_of(cx)
+
+            def write(cx, cursor, mask):
+                write_bytes(cx, cursor, mask, n_of(cx))
+
+            return size, write
+
+        def size(cx):
+            s = cx.sizes.get(path)
+            if s is None:
+                n = n_of(cx)
+                zlo, zhi = _zigzag32(n)
+                s = cx.sizes[path] = _varint_size(zlo, zhi) + n
+            return s
+
+        def write(cx, cursor, mask):
+            n = n_of(cx)
+            zlo, zhi = _zigzag32(n)
+            ns = _varint_size(zlo, zhi)
+            cx.out = _put_varint(cx.out, cursor, zlo, zhi, ns, mask)
+            write_bytes(cx, cursor + ns, mask, n)
+
+        return size, write
 
     # -- composites -------------------------------------------------------
 
@@ -466,10 +555,12 @@ class _EncLowering:
 
 def lower_encoder(ir: AvroType) -> EncProgram:
     """Lower a top-level record schema to its device encode program.
-    Subset = the decode subset (``gate.is_supported`` minus nested
-    repetition), so both directions gate identically."""
-    if not is_supported(ir):
-        raise UnsupportedOnDevice("schema is outside the fast-path subset")
+    Subset = the decode subset (``gate.device_supported``), so both
+    directions gate identically — the FULL reference type surface,
+    beyond the reference's own fast-encode subset
+    (``fast_encode.rs:22-24``)."""
+    if not device_supported(ir):
+        raise UnsupportedOnDevice("schema is outside the device subset")
     lo = _EncLowering()
     size, write = lo.lower_record(ir, "", ROWS)
     return EncProgram(
@@ -634,7 +725,7 @@ class _Extractor:
         self._require_valid(arr, path, parent)
 
         if isinstance(t, Primitive):
-            self._extract_primitive(t, arr, path, region)
+            self._extract_primitive(t, arr, path, region, parent)
             return
         if isinstance(t, Enum):
             self._extract_enum(t, arr, path, region, parent)
@@ -672,11 +763,12 @@ class _Extractor:
             self._extract_repeated(t, arr, path, region, parent)
             return
         if isinstance(t, Fixed):
-            self._extract_fixed(t, arr, path, region)
+            self._extract_fixed(t, arr, path, region, parent)
             return
         raise UnsupportedOnDevice(f"type {type(t).__name__} at {path!r}")
 
-    def _extract_fixed(self, t, arr, path, region) -> None:
+    def _extract_fixed(self, t, arr, path, region,
+                       parent=None) -> None:
         """Avro ``fixed`` → one raw byte run (size per entry); a
         ``duration`` Arrow input (Duration(ms) int64) converts back to
         the wire's (months, days, ms) u32-LE triple with the oracle's
@@ -684,7 +776,8 @@ class _Extractor:
         n = len(arr)
         size = t.size
         if t.logical == "decimal":
-            self._extract_decimal(arr, path, region)
+            self._extract_decimal(arr, path, region, fixed_size=size,
+                                  parent=parent)
             return
         if t.logical == "duration":
             import pyarrow.compute as pc
@@ -719,10 +812,23 @@ class _Extractor:
                 raw = np.frombuffer(
                     buf, np.uint8, count=(arr.offset + n) * size
                 )[arr.offset * size:]
-        self.put(path + "#fix", raw, region)
+        if self.host_mode:
+            self.put(path + "#fix", raw, region)  # the VM's dense column
+        else:
+            # device encode writes fixed runs through the bulk payload
+            # scatter: same #src/#len/#bytes layout as strings, with
+            # constant lens (see _EncLowering.lower_fixed)
+            self.put(
+                path + "#src",
+                (np.arange(n, dtype=np.int64) * size).astype(np.int32),
+                region,
+            )
+            self.put(path + "#len", np.full(n, size, np.int32), region)
+            self.byte_bufs[path + "#bytes"] = np.ascontiguousarray(raw)
         self.bound += size * n
 
-    def _extract_primitive(self, t: Primitive, arr, path, region) -> None:
+    def _extract_primitive(self, t: Primitive, arr, path, region,
+                           parent=None) -> None:
         name = t.name
         if name == "null":
             return
@@ -782,7 +888,7 @@ class _Extractor:
                 self._extract_string(arr, path, region)
         elif name == "bytes":
             if t.logical == "decimal":
-                self._extract_decimal(arr, path, region)
+                self._extract_decimal(arr, path, region, parent=parent)
             else:
                 # Binary shares Utf8's offsets+data layout
                 self._extract_string(arr, path, region)
@@ -813,12 +919,12 @@ class _Extractor:
         out[:, 14:18] = chars[:, 12:16]
         out[:, 19:23] = chars[:, 16:20]
         out[:, 24:36] = chars[:, 20:32]
-        # int64: n*36 would wrap int32 past ~59.6M rows (the byte bound
-        # below makes the codec split such batches before any consumer
-        # sees these offsets, but garbage must not exist to begin with)
+        # int32 like every #src: n*36 would wrap past ~59.6M rows, but
+        # the byte bound (37n < 2^30) splits such batches before any
+        # consumer sees these offsets
         self.put(
             path + "#src",
-            (np.arange(n, dtype=np.int64) * 36),
+            (np.arange(n, dtype=np.int64) * 36).astype(np.int32),
             region,
         )
         self.put(path + "#len", np.full(n, 36, np.int32), region)
@@ -827,9 +933,26 @@ class _Extractor:
         ).reshape(-1)
         self.bound += 37 * n  # 36 chars + 1-byte length varint
 
-    def _extract_decimal(self, arr, path, region) -> None:
+    @staticmethod
+    def _bitlen64(x: np.ndarray) -> np.ndarray:
+        """Vectorized bit length of a uint64 array."""
+        bits = np.zeros(x.shape, np.int32)
+        v = x.copy()
+        for s in (32, 16, 8, 4, 2, 1):
+            ge = v >= (np.uint64(1) << np.uint64(s))
+            bits += np.where(ge, s, 0).astype(np.int32)
+            v = np.where(ge, v >> np.uint64(s), v)
+        return bits + (v > 0).astype(np.int32)
+
+    def _extract_decimal(self, arr, path, region, fixed_size=None,
+                         parent=None) -> None:
         """Decimal128 values buffer: 16 bytes LE per entry (what the
-        encode VM's OP_DEC ops consume)."""
+        encode VM's OP_DEC ops consume). Device mode additionally
+        derives per-entry wire byte lengths (``#dlen``, the oracle's
+        ``max((abs_bit_length + 8) // 8, 1)``) for bytes-decimals, and
+        pre-checks fixed-decimals against their size — both vectorized
+        over the u64 halves; only LIVE entries are checked (a null slot
+        holds undefined buffer bytes)."""
         n = len(arr)
         buf = arr.buffers()[1]
         if buf is None:
@@ -839,6 +962,45 @@ class _Extractor:
                 buf, np.uint8, count=(arr.offset + n) * 16
             )[arr.offset * 16:]
         self.put(path + "#dec", raw, region)
+        if not self.host_mode and n:
+            w = np.ascontiguousarray(raw).view(np.uint64).reshape(n, 2)
+            lo, hi = w[:, 0], w[:, 1]
+            neg = (hi >> np.uint64(63)) != 0
+            lo_a = np.where(neg, (~lo) + np.uint64(1), lo)
+            hi_a = np.where(neg, (~hi) + (lo == 0).astype(np.uint64), hi)
+            live = self._valid(arr)
+            if parent is not None:
+                live = parent if live is None else (live & parent)
+            if fixed_size is None:
+                bits = np.where(
+                    hi_a > 0, 64 + self._bitlen64(hi_a), self._bitlen64(lo_a)
+                )
+                self.put(
+                    path + "#dlen",
+                    np.maximum((bits + 8) // 8, 1).astype(np.int32),
+                    region,
+                )
+            elif fixed_size < 16:
+                # signed-range fit: |v| < 2^(8s-1), or == for the most
+                # negative value (≙ the VM's check / int.to_bytes)
+                sbits = 8 * fixed_size - 1
+                if sbits >= 64:
+                    l_hi = np.uint64(1) << np.uint64(sbits - 64)
+                    l_lo = np.uint64(0)
+                else:
+                    l_hi = np.uint64(0)
+                    l_lo = np.uint64(1) << np.uint64(sbits)
+                over = (hi_a > l_hi) | ((hi_a == l_hi) & (lo_a > l_lo)) | (
+                    (~neg) & (hi_a == l_hi) & (lo_a == l_lo)
+                )
+                if live is not None:
+                    over = over & live
+                if over.any():
+                    raise OverflowError(
+                        "decimal value does not fit its fixed size"
+                    )
+        elif not self.host_mode and fixed_size is None:
+            self.put(path + "#dlen", np.zeros(0, np.int32), region)
         self.bound += 18 * n  # ≤16 value bytes + length varint
 
     def _extract_string(self, arr, path, region) -> None:
@@ -978,7 +1140,10 @@ def extract_batch(prog: EncProgram, batch: pa.RecordBatch,
         act[:ln] = 1
         dv["#active:%d" % rid] = act
     for key, (arr, rid) in ex.arrays.items():
-        P = pads[rid]
+        # per-entry arrays pad to the region bucket; multi-byte-per-
+        # entry arrays (#dec 16/entry, #fix size/entry) exceed it and
+        # pad to their own power-of-two bucket so jit shapes stay stable
+        P = pads[rid] if len(arr) <= pads[rid] else bucket_len(len(arr))
         if len(arr) < P:
             if key.endswith("#src"):
                 # pad with an out-of-range sentinel so padded elements

@@ -138,11 +138,68 @@ def test_device_widened_union_arms():
 
 
 @pytest.mark.slowcompile
-def test_widened_serialize_stays_on_native_vm():
-    """Serialize of widened schemas through the device codec must be
-    served by the native host VM, not the interpreted Python encoder
-    (regression: the widened decode gate used to reroute these to
-    ``fallback.encoder`` via ``DeviceCodec._host_encode``)."""
+def test_device_encode_widened_surface():
+    """Device ENCODE over the widened surface: wire-exact against the
+    oracle encoder (≙ the wire-compat strategy, ``fast_encode.rs:614-637``,
+    extended beyond the reference's own encode subset)."""
+    from pyruhvro_tpu.ops.encode import DeviceEncoder
+
+    e, datums = _wide_datums(300, seed=31)
+    batch = decode_to_record_batch(datums, e.ir, e.arrow_schema)
+    enc = DeviceEncoder(e.ir, e.arrow_schema)
+    got = [bytes(x) for x in enc.encode(batch).to_pylist()]
+    assert got == [bytes(d) for d in datums]
+
+
+@pytest.mark.slowcompile
+def test_device_encode_decimal_extremes_and_overflow():
+    import decimal
+
+    from pyruhvro_tpu.fallback.encoder import (
+        compile_encoder_plan,
+        encode_record_batch,
+    )
+    from pyruhvro_tpu.ops.encode import DeviceEncoder
+
+    s2 = """{"type":"record","name":"M","fields":[
+      {"name":"d","type":{"type":"bytes","logicalType":"decimal",
+          "precision":38,"scale":0}}]}"""
+    e2 = get_or_parse_schema(s2)
+    v = pa.array(
+        [decimal.Decimal(-(10 ** 38 - 1)), decimal.Decimal(10 ** 38 - 1),
+         decimal.Decimal(0)],
+        pa.decimal128(38, 0),
+    )
+    b2 = pa.RecordBatch.from_arrays([v], schema=e2.arrow_schema)
+    want = [
+        bytes(d)
+        for d in encode_record_batch(b2, e2.ir, compile_encoder_plan(e2.ir))
+    ]
+    got = [
+        bytes(x)
+        for x in DeviceEncoder(e2.ir, e2.arrow_schema).encode(b2).to_pylist()
+    ]
+    assert got == want
+
+    s3 = """{"type":"record","name":"F","fields":[
+      {"name":"d","type":{"type":"fixed","name":"D2","size":2,
+          "logicalType":"decimal","precision":6,"scale":0}}]}"""
+    e3 = get_or_parse_schema(s3)
+    b3 = pa.RecordBatch.from_arrays(
+        [pa.array([decimal.Decimal(40000)], pa.decimal128(6, 0))],
+        schema=e3.arrow_schema,
+    )
+    with pytest.raises(OverflowError, match="fixed size"):
+        DeviceEncoder(e3.ir, e3.arrow_schema).encode(b3)
+
+
+@pytest.mark.slowcompile
+def test_widened_serialize_served_fast():
+    """Serialize of widened schemas through the auto backend must be
+    served by a FAST path — the device encoder (whose subset now also
+    covers the full surface) or the native host VM — never the
+    interpreted Python encoder (regression: the widened decode gate
+    briefly rerouted these to ``fallback.encoder``)."""
     from pyruhvro_tpu import metrics
     from pyruhvro_tpu.api import serialize_record_batch
     from pyruhvro_tpu.hostpath import native_available
