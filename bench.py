@@ -372,6 +372,27 @@ def main() -> None:
                                   max(2, args.reps - 2), details,
                                   label=f"{name}/")
             save_details()
+        # widened-surface workload: the types the reference serves only
+        # via its Value-tree fallback (bytes/fixed/uuid/duration/
+        # decimal/time-*) are first-class on every backend here — this
+        # row quantifies the beyond-reference coverage at speed
+        from pyruhvro_tpu.utils.datagen import (
+            WIDENED_SCHEMA_JSON,
+            widened_datums,
+        )
+
+        wd = widened_datums(args.rows)
+        wd_dev = use_device and device_available(WIDENED_SCHEMA_JSON)
+        for backend in (["tpu"] if wd_dev else []) + ["host"]:
+            if backend == "host" and args.rows > args.host_cap:
+                continue
+            for op in ("deserialize", "serialize"):
+                _run_case(op, WIDENED_SCHEMA_JSON, wd, backend,
+                          args.chunks, max(2, args.reps - 2), details,
+                          label="widened/")
+        save_details()
+        print(_headline_line(), flush=True)
+
         # chunk sweep on the kafka workload (≙ benchmark_sweep.py)
         for chunks in (1, 2, 4, 16):
             for backend in backends:

@@ -789,9 +789,16 @@ class _Extractor:
             )
             days_total, ms_r = np.divmod(ms, 86_400_000)
             months, days = np.divmod(days_total, 30)
+            live = self._valid(arr)
+            if parent is not None:
+                live = parent if live is None else (live & parent)
             for name, v in (("months", months), ("days", days),
                             ("ms", ms_r)):
+                # only lanes the encoder will read can error — dead
+                # slots (nulls, non-selected union arms) hold garbage
                 bad = (v < 0) | (v >= (1 << 32))
+                if live is not None:
+                    bad = bad & live
                 if bad.any():
                     raise ValueError(
                         f"duration {name} component out of uint32 range "
@@ -968,9 +975,6 @@ class _Extractor:
             neg = (hi >> np.uint64(63)) != 0
             lo_a = np.where(neg, (~lo) + np.uint64(1), lo)
             hi_a = np.where(neg, (~hi) + (lo == 0).astype(np.uint64), hi)
-            live = self._valid(arr)
-            if parent is not None:
-                live = parent if live is None else (live & parent)
             if fixed_size is None:
                 bits = np.where(
                     hi_a > 0, 64 + self._bitlen64(hi_a), self._bitlen64(lo_a)
@@ -983,6 +987,9 @@ class _Extractor:
             elif fixed_size < 16:
                 # signed-range fit: |v| < 2^(8s-1), or == for the most
                 # negative value (≙ the VM's check / int.to_bytes)
+                live = self._valid(arr)
+                if parent is not None:
+                    live = parent if live is None else (live & parent)
                 sbits = 8 * fixed_size - 1
                 if sbits >= 64:
                     l_hi = np.uint64(1) << np.uint64(sbits - 64)

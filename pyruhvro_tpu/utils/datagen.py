@@ -305,3 +305,102 @@ def random_schema(seed: int, max_depth: int = 3) -> str:
     return _json.dumps(
         {"type": "record", "name": f"Fuzz{seed}", "fields": fields}
     )
+
+
+# ---------------------------------------------------------------------------
+# widened-surface workload (beyond the reference's fast subset)
+# ---------------------------------------------------------------------------
+
+WIDENED_SCHEMA_JSON = """\
+{
+  "type": "record",
+  "name": "Wide",
+  "fields": [
+    {"name": "b", "type": "bytes"},
+    {"name": "nb", "type": ["null", "bytes"]},
+    {"name": "f8", "type": {"type": "fixed", "name": "F8", "size": 8}},
+    {"name": "nf", "type": ["null", {"type": "fixed", "name": "F3", "size": 3}]},
+    {"name": "uid", "type": {"type": "string", "logicalType": "uuid"}},
+    {"name": "dur", "type": {"type": "fixed", "name": "Dur", "size": 12,
+                             "logicalType": "duration"}},
+    {"name": "dec", "type": {"type": "bytes", "logicalType": "decimal",
+                             "precision": 20, "scale": 4}},
+    {"name": "ndec", "type": ["null", {"type": "bytes", "logicalType": "decimal",
+                              "precision": 10, "scale": 2}]},
+    {"name": "decf", "type": {"type": "fixed", "name": "DF", "size": 9,
+                              "logicalType": "decimal", "precision": 16,
+                              "scale": 2}},
+    {"name": "tm", "type": {"type": "int", "logicalType": "time-millis"}},
+    {"name": "tu", "type": {"type": "long", "logicalType": "time-micros"}},
+    {"name": "lts", "type": {"type": "long",
+                             "logicalType": "local-timestamp-micros"}},
+    {"name": "ab", "type": {"type": "array", "items": "bytes"}}
+  ]
+}
+"""
+
+
+def widened_datums(n: int, seed: int = 0) -> List[bytes]:
+    """Wire datums over the WIDENED type surface — the types the
+    reference serves only via its Value-tree fallback (bytes, fixed,
+    uuid, duration, decimal, time-*), here first-class on every backend.
+    Values stay in-range (duration under int64 ms, decimals within
+    precision) so all paths and the oracle agree exactly."""
+    import uuid as _uuid
+
+    rng = random.Random(seed)
+    out = []
+
+    def vint(buf, v):
+        z = (v << 1) ^ (v >> 63) if v < 0 else v << 1
+        while z >= 0x80:
+            buf.append((z & 0x7F) | 0x80)
+            z >>= 7
+        buf.append(z)
+
+    def wbytes(buf, b):
+        vint(buf, len(b))
+        buf += b
+
+    for _ in range(n):
+        buf = bytearray()
+        wbytes(buf, rng.randbytes(rng.randrange(0, 24)))          # b
+        if rng.random() < 0.3:
+            vint(buf, 0)                                          # nb null
+        else:
+            vint(buf, 1)
+            wbytes(buf, rng.randbytes(5))
+        buf += rng.randbytes(8)                                   # f8
+        if rng.random() < 0.5:
+            vint(buf, 0)                                          # nf null
+        else:
+            vint(buf, 1)
+            buf += rng.randbytes(3)
+        wbytes(buf, str(_uuid.UUID(int=rng.getrandbits(128)))
+               .encode())                                         # uid
+        for comp in (rng.randrange(0, 12), rng.randrange(0, 28),
+                     rng.randrange(0, 86_400_000)):               # dur
+            buf += comp.to_bytes(4, "little")
+        v = rng.randrange(-(10 ** 19), 10 ** 19)                  # dec
+        nb_ = max((abs(v).bit_length() + 8) // 8, 1)
+        wbytes(buf, v.to_bytes(nb_, "big", signed=True))
+        if rng.random() < 0.4:
+            vint(buf, 0)                                          # ndec null
+        else:
+            vint(buf, 1)
+            v = rng.randrange(-(10 ** 9), 10 ** 9)
+            nb_ = max((abs(v).bit_length() + 8) // 8, 1)
+            wbytes(buf, v.to_bytes(nb_, "big", signed=True))
+        v = rng.randrange(-(10 ** 15), 10 ** 15)                  # decf
+        buf += v.to_bytes(9, "big", signed=True)
+        vint(buf, rng.randrange(0, 86_400_000))                   # tm
+        vint(buf, rng.randrange(0, 86_400_000_000))               # tu
+        vint(buf, rng.randrange(0, 2 ** 50))                      # lts
+        cnt = rng.randrange(0, 4)                                 # ab
+        if cnt:
+            vint(buf, cnt)
+            for _i in range(cnt):
+                wbytes(buf, rng.randbytes(rng.randrange(0, 6)))
+        vint(buf, 0)
+        out.append(bytes(buf))
+    return out

@@ -24,63 +24,15 @@ from pyruhvro_tpu.ops.arrow_build import build_record_batch
 from pyruhvro_tpu.ops.decode import DeviceDecoder
 from pyruhvro_tpu.schema.cache import get_or_parse_schema
 
-WIDE_SCHEMA = """{"type":"record","name":"Wide","fields":[
-  {"name":"b","type":"bytes"},
-  {"name":"nb","type":["null","bytes"]},
-  {"name":"f8","type":{"type":"fixed","name":"F8","size":8}},
-  {"name":"nf","type":["null",{"type":"fixed","name":"F3","size":3}]},
-  {"name":"uid","type":{"type":"string","logicalType":"uuid"}},
-  {"name":"dur","type":{"type":"fixed","name":"Dur","size":12,
-      "logicalType":"duration"}},
-  {"name":"dec","type":{"type":"bytes","logicalType":"decimal",
-      "precision":20,"scale":4}},
-  {"name":"ndec","type":["null",{"type":"bytes","logicalType":"decimal",
-      "precision":10,"scale":2}]},
-  {"name":"decf","type":{"type":"fixed","name":"DF","size":9,
-      "logicalType":"decimal","precision":16,"scale":2}},
-  {"name":"tm","type":{"type":"int","logicalType":"time-millis"}},
-  {"name":"tu","type":{"type":"long","logicalType":"time-micros"}},
-  {"name":"lts","type":{"type":"long",
-      "logicalType":"local-timestamp-micros"}},
-  {"name":"ab","type":{"type":"array","items":"bytes"}}
-]}"""
+# single source of truth for the widened workload: the bench's own
+# generator (pyruhvro_tpu/utils/datagen.py), so the differential suite
+# and the bench "widened/" phase measure the exact same surface
+from pyruhvro_tpu.utils.datagen import WIDENED_SCHEMA_JSON as WIDE_SCHEMA
+from pyruhvro_tpu.utils.datagen import widened_datums
 
 
 def _wide_datums(n=400, seed=5):
-    import decimal
-    import uuid as uuid_mod
-
-    e = get_or_parse_schema(WIDE_SCHEMA)
-    rng = random.Random(seed)
-
-    def dec(prec, scale):
-        q = decimal.Decimal(rng.randrange(-(10 ** (prec - 1)),
-                                          10 ** (prec - 1)))
-        return q.scaleb(-scale)
-
-    rows = []
-    for _ in range(n):
-        rows.append({
-            "b": rng.randbytes(rng.randrange(0, 24)),
-            "nb": None if rng.random() < 0.3 else rng.randbytes(5),
-            "f8": rng.randbytes(8),
-            "nf": None if rng.random() < 0.5 else rng.randbytes(3),
-            "uid": uuid_mod.UUID(int=rng.getrandbits(128)).bytes,
-            "dur": rng.randrange(0, 10 ** 12),
-            "dec": dec(20, 4),
-            "ndec": None if rng.random() < 0.4 else dec(10, 2),
-            "decf": dec(16, 2),
-            "tm": rng.randrange(0, 86_400_000),
-            "tu": rng.randrange(0, 86_400_000_000),
-            "lts": rng.randrange(0, 2 ** 50),
-            "ab": [rng.randbytes(rng.randrange(0, 6))
-                   for _ in range(rng.randrange(0, 4))],
-        })
-    batch = pa.RecordBatch.from_pylist(rows, schema=e.arrow_schema)
-    return e, [
-        bytes(d)
-        for d in encode_record_batch(batch, e.ir, compile_encoder_plan(e.ir))
-    ]
+    return get_or_parse_schema(WIDE_SCHEMA), widened_datums(n, seed=seed)
 
 
 @pytest.mark.slowcompile
@@ -213,9 +165,8 @@ def test_widened_serialize_served_fast():
     flat = [bytes(x) for a in out for x in a.to_pylist()]
     assert flat == [bytes(d) for d in datums]
     snap = metrics.snapshot()
-    # device encode covers the fast subset only -> the native VM must
-    # have served it (encode.compiles would mark the device encoder,
-    # host.encode_vm_s the VM; the Python fallback would mark neither)
+    # encode.compiles/launches marks the device encoder,
+    # host.encode_vm_s the native VM; the Python fallback marks neither
     assert snap.get("host.encode_vm_s", 0) > 0 or (
         snap.get("encode.compiles", 0) + snap.get("encode.launches", 0) > 0
     )

@@ -57,6 +57,26 @@ def test_sharded_matches_oracle_kafka():
     _sharded_diff(KAFKA_SCHEMA_JSON, kafka_style_datums(200, seed=31), 8)
 
 
+def test_sharded_widened_surface_both_directions():
+    """The widened device subset (bytes/fixed/uuid/decimal/duration)
+    must shard like the fast subset — decode differential per chunk AND
+    wire-exact sharded encode over the same mesh."""
+    from test_device_widened import WIDE_SCHEMA, _wide_datums
+
+    from pyruhvro_tpu.parallel import ShardedEncoder
+    from pyruhvro_tpu.runtime.chunking import chunk_bounds
+
+    entry, datums = _wide_datums(150, seed=41)
+    _sharded_diff(WIDE_SCHEMA, datums, 8)
+    batch = decode_to_record_batch(datums, entry.ir, entry.arrow_schema)
+    enc = ShardedEncoder(entry.ir, entry.arrow_schema,
+                         mesh=chunk_mesh(n_devices=8))
+    arrays = enc.encode(batch)
+    bounds = chunk_bounds(len(datums), 8)
+    assert [len(a) for a in arrays] == [b - a for a, b in bounds]
+    assert [bytes(x) for a in arrays for x in a] == [bytes(d) for d in datums]
+
+
 @pytest.mark.parametrize("n_devices", [2, 4, 8])
 def test_sharded_mesh_sizes(n_devices):
     entry = get_or_parse_schema(SHAPES["flat"])
