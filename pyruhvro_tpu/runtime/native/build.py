@@ -54,7 +54,10 @@ def _needs_build(so: str, src: str) -> bool:
         with open(so + ".buildinfo") as f:
             return f.read().strip() != _cpu_tag()
     except OSError:
-        return True  # unknown build host: rebuild for this one
+        # no sidecar = a wheel/sdist build (setup.py), which uses generic
+        # flags and is safe on any host; only this module's JIT builds
+        # use -march=native, and they always write the sidecar
+        return False
 
 
 def _compile(so: str, src: str) -> None:
