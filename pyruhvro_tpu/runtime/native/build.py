@@ -76,9 +76,14 @@ def _compile(so: str, src: str) -> None:
                            check=True, capture_output=True, text=True)
         except subprocess.CalledProcessError:
             subprocess.run(base, check=True, capture_output=True, text=True)
-        os.replace(tmp, so)
+        # sidecar BEFORE publishing the .so: a -march=native binary must
+        # never exist without its CPU tag (a kill between the two writes
+        # would otherwise leave a native .so that _needs_build trusts as
+        # a generic build). A sidecar next to an older .so is harmless —
+        # the tag describes this host either way.
         with open(so + ".buildinfo", "w") as f:
             f.write(_cpu_tag())
+        os.replace(tmp, so)
     finally:
         if os.path.exists(tmp):
             os.unlink(tmp)
