@@ -160,9 +160,10 @@ class NativeHostCodec:
                 raise  # oracle parity (int.to_bytes overflow) — a
                 # batch split cannot make the value fit
             raise BatchTooLarge(n, -1)
+        from ..ops.arrow_build import cumsum0
+
         sizes = np.frombuffer(sizes, np.int32)
-        offsets = np.zeros(n + 1, np.int32)
-        np.cumsum(sizes, out=offsets[1:])
+        offsets = cumsum0(sizes)  # VM bounds the total to int32
         return pa.Array.from_buffers(
             pa.binary(), n,
             [None, pa.py_buffer(offsets),

@@ -95,6 +95,27 @@ def _combine64(lo: np.ndarray, hi: np.ndarray, view) -> np.ndarray:
     return out.view(view)
 
 
+def cumsum0(lens: np.ndarray) -> np.ndarray:
+    """Arrow offsets (leading 0) from an int32 length vector.
+
+    Prefix sums are inherently sequential — numpy's scalar loop costs
+    ~3 ns/element — so the native module's C version is used when it is
+    ALREADY loaded (never triggering a JIT g++ build from the assembly
+    hot path — a device-only process may legitimately have no .so).
+    Callers guard the int32 total themselves; the native path would
+    raise OverflowError, the numpy path would wrap."""
+    from ..runtime.native import build as _nb
+
+    mod = _nb._modules.get("_pyruhvro_hostcodec")
+    if mod is not None and hasattr(mod, "cumsum0"):
+        return np.frombuffer(
+            mod.cumsum0(np.ascontiguousarray(lens, np.int32)), np.int32
+        )
+    voff = np.zeros(len(lens) + 1, np.int32)
+    np.cumsum(lens, out=voff[1:])
+    return voff
+
+
 class _Assembler:
     def __init__(self, host: Dict[str, np.ndarray], meta):
         self.host = host
@@ -208,8 +229,7 @@ class _Assembler:
                 f"column {path!r} carries {total} value bytes — over "
                 f"the 2 GiB Binary/Utf8 capacity; split the batch"
             )
-        voff = np.zeros(count + 1, np.int32)
-        np.cumsum(lens, out=voff[1:])
+        voff = cumsum0(lens)  # capacity-checked above
         if path + "#bytes" in self.host:
             values = self.host[path + "#bytes"][:total]
         else:
@@ -413,9 +433,13 @@ class _Assembler:
         sym_starts = np.zeros(len(t.symbols), np.int32)
         np.cumsum(sym_lens[:-1], out=sym_starts[1:])
         lens = sym_lens[idx]
-        offsets = np.zeros(count + 1, np.int32)
-        np.cumsum(lens, out=offsets[1:])
-        total = int(offsets[count])
+        total = int(lens.sum(dtype=np.int64))
+        if total >= (1 << 31):
+            raise pa.lib.ArrowCapacityError(
+                f"enum column {path!r} expands to {total} symbol bytes — "
+                f"over the 2 GiB Utf8 capacity; split the batch"
+            )
+        offsets = cumsum0(lens)
         pos = np.arange(total, dtype=np.int64) - np.repeat(offsets[:-1], lens)
         src = np.repeat(sym_starts[idx], lens) + pos
         values = sym_bytes[src]

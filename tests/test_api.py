@@ -94,7 +94,7 @@ def test_backend_tpu_rejects_unsupported_schema():
         "type": "record", "name": "W",
         "fields": [{"name": "d", "type": {
             "type": "fixed", "name": "F20", "size": 20,
-            "logicalType": "decimal", "precision": 44, "scale": 2}}],
+            "logicalType": "decimal", "precision": 38, "scale": 2}}],
     })
     with pytest.raises(ValueError, match="outside the device subset"):
         pv.deserialize_array([b"\x00" * 20], wide_dec, backend="tpu")

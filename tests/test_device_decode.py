@@ -172,10 +172,15 @@ def test_nested_repetition_deep():
 
 
 def test_out_of_subset_schema_unsupported_on_device():
-    # bytes stays host-only; the public API silently serves it
+    # the device subset now covers the full reference surface (bytes
+    # included — tests/test_device_widened.py); the one exclusion left
+    # is a fixed decimal wider than decimal128. The public API silently
+    # serves it from the host path (≙ deserialize.rs:26-29).
     schema = json.dumps({
         "type": "record", "name": "B",
-        "fields": [{"name": "b", "type": "bytes"}],
+        "fields": [{"name": "d", "type": {
+            "type": "fixed", "name": "F20", "size": 20,
+            "logicalType": "decimal", "precision": 38, "scale": 0}}],
     })
     entry = get_or_parse_schema(schema)
     with pytest.raises(UnsupportedOnDevice):
@@ -212,10 +217,12 @@ def test_negative_block_counts_device():
 def test_backend_tpu_rejects_unsupported_schema():
     schema = json.dumps({
         "type": "record", "name": "U",
-        "fields": [{"name": "b", "type": "bytes"}],
+        "fields": [{"name": "d", "type": {
+            "type": "fixed", "name": "F20", "size": 20,
+            "logicalType": "decimal", "precision": 38, "scale": 0}}],
     })
     with pytest.raises(ValueError):
-        pv.deserialize_array([b"\x02\x00"], schema, backend="tpu")
+        pv.deserialize_array([b"\x00" * 20], schema, backend="tpu")
 
 
 def test_zero_byte_items_array_of_nulls():
