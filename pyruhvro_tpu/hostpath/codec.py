@@ -88,6 +88,9 @@ class NativeHostCodec:
                 self.ir, self.arrow_schema, host, n, meta
             )
 
+    # NOTE: the C++ VM's sampled-reserve prepass activates at
+    # 4 * _PER_CHUNK_ROWS rows (host_codec.cpp py_decode) — keep the
+    # two in sight of each other when retuning.
     # Above this many rows per chunk, each chunk decodes independently:
     # a chunk's whole working set (VM builders + assembly) then stays
     # cache-resident, which measures ~2x faster than decode-once+slice
@@ -157,8 +160,6 @@ class NativeHostCodec:
             return pa.array([], pa.binary())
         step = self._PER_CHUNK_ROWS * 2
         if n > step:  # strict: a recursing sub-slice is exactly `step`
-            from ..ops.decode import BatchTooLarge as _BTL
-
             try:
                 return pa.concat_arrays([
                     self.encode(batch.slice(a, min(step, n - a)))
@@ -168,7 +169,7 @@ class NativeHostCodec:
                 # each sub-slice fit, but the CONCATENATED offsets blow
                 # int32 — the same capacity condition the single-pass VM
                 # reports, surfaced through the library's contract
-                raise _BTL(n, -1)
+                raise BatchTooLarge(n, -1)
         with metrics.timer("host.extract_s"):
             ex = run_extractor(self.ir, batch, host_mode=True)
             bufs = self._encode_buffers(ex)
@@ -233,7 +234,13 @@ class NativeHostCodec:
             if batch.num_rows < 2:
                 raise
             mid = batch.num_rows // 2
-            return pa.concat_arrays(
-                [self._encode_split(batch.slice(0, mid)),
-                 self._encode_split(batch.slice(mid))]
-            )
+            try:
+                return pa.concat_arrays(
+                    [self._encode_split(batch.slice(0, mid)),
+                     self._encode_split(batch.slice(mid))]
+                )
+            except pa.lib.ArrowInvalid:
+                # the halves fit individually but their concatenation
+                # blows int32 offsets: no split can make this batch one
+                # BinaryArray — the caller must use more chunks
+                raise BatchTooLarge(batch.num_rows, -1)

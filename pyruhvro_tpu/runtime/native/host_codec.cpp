@@ -928,6 +928,9 @@ PyObject* py_decode(PyObject*, PyObject* args) {
   // the prepass is serial; with worker threads, thin the sample so its
   // Amdahl share stays ~1/64 of ONE thread's work, not of the wall
   const int64_t kSampleEvery = 64 * (nt > 1 ? nt : 1);
+  // = 4 * the host codec's _PER_CHUNK_ROWS (hostpath/codec.py): the
+  // per-chunk decode mode keeps chunks below this, so the prepass only
+  // engages for genuinely giant single passes
   if (n > 262144) {
     std::vector<Span> sample;
     sample.reserve((size_t)(n / kSampleEvery) + 1);

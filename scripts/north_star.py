@@ -110,23 +110,34 @@ def single10m(rows: int) -> None:
 
 
 def roundtrip100m(rows: int, chunks: int = 8) -> None:
-    from pyruhvro_tpu import deserialize_array, serialize_record_batch
+    from pyruhvro_tpu import deserialize_array_threaded, serialize_record_batch
 
     _warm_routing()
     per = rows // chunks
+    # inner chunking (~1M rows each) drives the library's own parallel
+    # API per piece — the per-chunk cache-resident execution the codec
+    # uses at scale (BENCH_NOTES.md "Scale behavior")
+    inner = max(1, per // 1_000_000)
     t_de = t_en = 0.0
     checked = 0
     for c in range(chunks):
         base = _gen(per, seed=7 + c)  # distinct data per chunk
         t0 = time.perf_counter()
-        batch = deserialize_array(base, _schema())
+        batches = deserialize_array_threaded(base, _schema(), inner)
         t_de += time.perf_counter() - t0
+        assert sum(b.num_rows for b in batches) == per
         t0 = time.perf_counter()
-        (arr,) = serialize_record_batch(batch, _schema(), 1)
+        arrays = [
+            a for b in batches
+            for a in serialize_record_batch(b, _schema(), 1)
+        ]
         t_en += time.perf_counter() - t0
-        assert len(arr) == per
         # byte-exact round trip for the whole chunk
-        assert arr.equals(_pa().array([bytes(d) for d in base], _pa().binary()))
+        flat = _pa().concat_arrays(arrays)
+        assert len(flat) == per
+        assert flat.equals(
+            _pa().array([bytes(d) for d in base], _pa().binary())
+        )
         checked += per
         _log(f"[north-star] chunk {c + 1}/{chunks}: {checked:,} rows "
              f"round-tripped byte-exact")

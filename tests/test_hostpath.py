@@ -375,3 +375,43 @@ def test_deep_nesting_and_unions():
     got = c.decode(datums)
     want = decode_to_record_batch(datums, e.ir, e.arrow_schema)
     assert got.equals(want)
+
+
+def test_large_batch_branches_small_threshold(monkeypatch):
+    """The large-batch execution modes (per-chunk decode_threaded,
+    encode sub-slice + concat) activate at 64k+ rows — far above unit
+    sizes — so exercise them by shrinking the threshold: results must be
+    identical to the small-batch paths, and a malformed datum must still
+    report its GLOBAL index from the per-chunk mode."""
+    import pyarrow as pa
+
+    from pyruhvro_tpu.fallback.decoder import decode_to_record_batch
+    from pyruhvro_tpu.fallback.io import MalformedAvro
+    from pyruhvro_tpu.hostpath.codec import NativeHostCodec
+
+    e = get_or_parse_schema(KAFKA_SCHEMA_JSON)
+    codec = NativeHostCodec(e.ir, e.arrow_schema)
+    monkeypatch.setattr(NativeHostCodec, "_PER_CHUNK_ROWS", 8)
+    datums = kafka_style_datums(100, seed=21)
+    want = decode_to_record_batch(datums, e.ir, e.arrow_schema)
+
+    # per-chunk decode (100 >= 8 * 4 chunks)
+    batches = codec.decode_threaded(datums, 4)
+    assert len(batches) == 4
+    got = pa.Table.from_batches(batches).combine_chunks().to_batches()[0]
+    assert got.equals(want)
+
+    # encode sub-slice + concat (100 > 2 * 8)
+    arr = codec.encode(want)
+    assert [bytes(x) for x in arr.to_pylist()] == [bytes(d) for d in datums]
+    # per-chunk encode_threaded
+    arrs = codec.encode_threaded(want, 4)
+    assert [bytes(x) for a in arrs for x in a.to_pylist()] == [
+        bytes(d) for d in datums
+    ]
+
+    # global record index from the per-chunk decode mode
+    bad = list(datums)
+    bad[83] = b"\x07\xff"
+    with pytest.raises(MalformedAvro, match="record 83"):
+        codec.decode_threaded(bad, 4)
