@@ -131,7 +131,29 @@ class DeviceCodec:
         self.entry = entry
         self.ir = entry.ir
         self.arrow_schema = entry.arrow_schema
-        self.decoder = DeviceDecoder(entry.ir)
+        # opt-in: run the decode walk as the Pallas kernel instead of
+        # the XLA pipeline for schemas it supports (flat, no array/map)
+        # — same lowered field program, explicit-kernel execution
+        # (ops/pallas_decode.py). The XLA pipeline stays the default:
+        # its fused single-blob transfer is tuned for high-latency
+        # interconnects, and it covers repeated fields. Accepted values:
+        # "1"/"true" (compiled Mosaic) or "interpret"; anything else —
+        # incl. the conventional "0" — leaves the flag off.
+        import os
+
+        pallas_flag = os.environ.get("PYRUHVRO_TPU_PALLAS", "").lower()
+        self.decoder = None
+        if pallas_flag in ("1", "true", "interpret"):
+            try:
+                from .pallas_decode import PallasKernelDecoder
+
+                self.decoder = PallasKernelDecoder(
+                    entry.ir, interpret=pallas_flag == "interpret"
+                )
+            except UnsupportedOnDevice:
+                pass  # repeated fields: the XLA pipeline serves them
+        if self.decoder is None:
+            self.decoder = DeviceDecoder(entry.ir)
         self._encoder = None
         self._sharded = None  # lazily: ShardedDecoder | False (single-chip)
         # probe the backend now: a missing/broken device must fail at
@@ -185,6 +207,10 @@ class DeviceCodec:
             # same degradation the reference applies to unsupported
             # schemas, deserialize.rs:26-29 — here per batch)
             return self._host_decode(data)
+        except UnsupportedOnDevice:
+            # per-batch limits of an alternative walk (e.g. the Pallas
+            # kernel's per-record tile budget): host path, silently
+            return self._host_decode(data)
         from .arrow_build import build_record_batch
 
         return build_record_batch(self.ir, self.arrow_schema, host, n, meta)
@@ -193,6 +219,10 @@ class DeviceCodec:
         """The mesh-sharded decoder when >1 device is attached, else None
         (single chip: the fused single-launch path is already optimal)."""
         if self._sharded is None:
+            if not isinstance(self.decoder, DeviceDecoder):
+                # alternative walks (Pallas opt-in) run single-device
+                self._sharded = False
+                return None
             import jax
 
             devs = jax.devices()

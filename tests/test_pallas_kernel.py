@@ -131,3 +131,48 @@ def test_pallas_trailing_bytes_raise():
     with pytest.raises(MalformedAvro) as ei:
         _kernel_decode(schema, datums)
     assert "record 4" in str(ei.value)
+
+
+@pytest.mark.slowcompile
+def test_pallas_opt_in_api_routing(monkeypatch):
+    """PYRUHVRO_TPU_PALLAS routes supported flat schemas through the
+    Pallas walk via the public API; repeated-field schemas silently stay
+    on the XLA pipeline; oversized records fall back to the host path."""
+    import pyarrow as pa
+
+    from pyruhvro_tpu.api import deserialize_array_threaded
+    from pyruhvro_tpu.ops.pallas_decode import PallasKernelDecoder
+    from pyruhvro_tpu.schema.cache import get_or_parse_schema
+
+    monkeypatch.setenv("PYRUHVRO_TPU_PALLAS", "interpret")
+
+    schema = CRITERION_SHAPES["flat_primitives"]
+    arr_schema = CRITERION_SHAPES["array_and_map"]
+    e = get_or_parse_schema(schema)
+    e2 = get_or_parse_schema(arr_schema)
+    e._extras.pop("device_codec", None)  # rebuild under the env flag
+    e2._extras.pop("device_codec", None)
+    try:
+        datums = random_datums(e.ir, 200, seed=77)
+        out = deserialize_array_threaded(datums, schema, 4, backend="tpu")
+        got = pa.Table.from_batches(out).combine_chunks().to_batches()[0]
+        want = decode_to_record_batch(datums, e.ir, to_arrow_schema(e.ir))
+        assert got.equals(want)
+        from pyruhvro_tpu.ops.codec import get_device_codec
+
+        assert isinstance(get_device_codec(e).decoder, PallasKernelDecoder)
+
+        d2 = random_datums(e2.ir, 50, seed=78)
+        out2 = deserialize_array_threaded(d2, arr_schema, 2, backend="tpu")
+        got2 = pa.Table.from_batches(out2).combine_chunks().to_batches()[0]
+        assert got2.equals(
+            decode_to_record_batch(d2, e2.ir, to_arrow_schema(e2.ir))
+        )
+        assert not isinstance(
+            get_device_codec(e2).decoder, PallasKernelDecoder
+        )
+    finally:
+        # the schema cache is process-wide: codecs built under the env
+        # flag must not leak into later tests even when asserts fail
+        e._extras.pop("device_codec", None)
+        e2._extras.pop("device_codec", None)
