@@ -22,7 +22,7 @@ from .api import (
     serialize_record_batch,
     serialize_record_batch_spawn,
 )
-from .gate import is_supported
+from .gate import device_supported, host_supported, is_supported
 from .runtime import metrics
 from .schema import parse_schema, to_arrow_schema
 
@@ -35,6 +35,8 @@ __all__ = [
     "serialize_record_batch",
     "serialize_record_batch_spawn",
     "is_supported",
+    "host_supported",
+    "device_supported",
     "parse_schema",
     "to_arrow_schema",
     "metrics",

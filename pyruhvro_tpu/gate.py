@@ -1,12 +1,16 @@
-"""Fast-path schema gate.
+"""Fast-path schema gates.
 
-≙ ``fast_decode::is_supported`` (``ruhvro/src/fast_decode.rs:38-61``):
-the top level must be a record, and every reachable type must be in the
-fast subset — primitives (null/boolean/int/long/float/double/string),
-date / timestamp-millis / timestamp-micros logical types, enum, record,
-union, array, map. Outside the subset (bytes, fixed, decimal, uuid,
-duration, time-millis/micros, local-timestamps): the call silently uses
-the general fallback path, exactly like the reference
+:func:`is_supported` ≙ ``fast_decode::is_supported``
+(``ruhvro/src/fast_decode.rs:38-61``), kept as the exact REFERENCE
+subset for parity documentation: record top level; primitives
+(null/boolean/int/long/float/double/string), date /
+timestamp-millis/micros logical types, enum, record, union, array, map.
+
+This framework's own fast paths gate WIDER: :func:`host_supported` /
+:func:`device_supported` add bytes, fixed, decimal (≤ decimal128),
+uuid, duration, time-* and local-timestamp-* — the types the reference
+serves only via its Value-tree fallback. Out-of-subset schemas silently
+use the general fallback path, exactly like the reference
 (``deserialize.rs:26-29``).
 """
 

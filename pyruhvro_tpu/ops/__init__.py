@@ -16,8 +16,11 @@ __all__ = ["UnsupportedOnDevice"]
 
 
 class UnsupportedOnDevice(ValueError):
-    """Schema is valid but outside the *device* kernel's subset (the
-    fast-path subset: bytes/fixed/decimal/uuid/duration/time-* are
-    host-only). ``backend='auto'`` falls back to the host path silently,
-    matching the reference's unsupported-schema gate
+    """Schema is valid but outside the requested fast path's subset.
+
+    The device subset covers the FULL reference type surface
+    (``gate.device_supported``) — the only exclusion is fixed decimals
+    wider than decimal128's 16 bytes; the Pallas walk additionally
+    excludes repeated fields (array/map). ``backend='auto'`` falls back
+    silently, matching the reference's unsupported-schema gate
     (``deserialize.rs:26-29``)."""
