@@ -127,7 +127,7 @@ def _probe_backend() -> None:
 class DeviceCodec:
     """Schema-bound decode/encode pipelines on the default JAX backend."""
 
-    def __init__(self, entry: SchemaEntry):
+    def __init__(self, entry: SchemaEntry, pallas: str | None = None):
         self.entry = entry
         self.ir = entry.ir
         self.arrow_schema = entry.arrow_schema
@@ -136,14 +136,13 @@ class DeviceCodec:
         # — same lowered field program, explicit-kernel execution
         # (ops/pallas_decode.py). The XLA pipeline stays the default:
         # its fused single-blob transfer is tuned for high-latency
-        # interconnects, and it covers repeated fields. Accepted values:
-        # "1"/"true" (compiled Mosaic) or "interpret"; anything else —
-        # incl. the conventional "0" — leaves the flag off.
-        import os
-
-        pallas_flag = os.environ.get("PYRUHVRO_TPU_PALLAS", "").lower()
+        # interconnects, and it covers repeated fields. The mode comes
+        # from the caller (``get_device_codec`` reads the env ONCE and
+        # folds the same value into its memo key — re-reading here could
+        # cache a codec under a key that doesn't match its decoder).
+        pallas_flag = (_pallas_mode() if pallas is None else pallas)
         self.decoder = None
-        if pallas_flag in ("1", "true", "interpret"):
+        if pallas_flag in ("mosaic", "interpret"):
             try:
                 from .pallas_decode import PallasKernelDecoder
 
@@ -304,10 +303,15 @@ class DeviceCodec:
             if batch.num_rows < 2:
                 return self._host_encode(batch)
             mid = batch.num_rows // 2
-            return pa.concat_arrays([
-                self.encode(batch.slice(0, mid)),
-                self.encode(batch.slice(mid)),
-            ])
+            try:
+                return pa.concat_arrays([
+                    self.encode(batch.slice(0, mid)),
+                    self.encode(batch.slice(mid)),
+                ])
+            except pa.lib.ArrowInvalid:
+                # halves fit individually but their concatenation blows
+                # int32 offsets (≙ hostpath _encode_split)
+                raise BatchTooLarge(batch.num_rows, -1) from None
 
     def _host_encode(self, batch: pa.RecordBatch) -> pa.Array:
         """Host-path encode for schemas/batches the device encoder hands
@@ -324,12 +328,24 @@ class DeviceCodec:
             try:
                 return native.encode(batch)
             except _BTL:
-                if batch.num_rows >= 2:
-                    mid = batch.num_rows // 2
+                if batch.num_rows < 2:
+                    # a single record that alone blows int32 offsets
+                    # cannot be split, and the interpreted fallback
+                    # below cannot represent it either — surface the
+                    # library's BatchTooLarge contract instead of
+                    # burning time on a doomed pyarrow build (ADVICE r04)
+                    raise
+                mid = batch.num_rows // 2
+                try:
                     return pa.concat_arrays([
                         self._host_encode(batch.slice(0, mid)),
                         self._host_encode(batch.slice(mid)),
                     ])
+                except pa.lib.ArrowInvalid:
+                    # halves fit individually but their concatenation
+                    # blows int32 offsets: no split can make this batch
+                    # one BinaryArray (≙ hostpath _encode_split)
+                    raise BatchTooLarge(batch.num_rows, -1) from None
         from ..fallback.encoder import (
             compile_encoder_plan,
             encode_record_batch,
@@ -352,7 +368,31 @@ def _concat_batches(batches: List[pa.RecordBatch]) -> pa.RecordBatch:
     return out[0] if out else batches[0]
 
 
+def _pallas_mode() -> str:
+    """Normalize PYRUHVRO_TPU_PALLAS to its three semantic states:
+    ``"mosaic"`` ("1"/"true" — compiled kernel), ``"interpret"``, or
+    ``"off"`` (anything else, incl. the conventional "0")."""
+    import os
+
+    raw = os.environ.get("PYRUHVRO_TPU_PALLAS", "").lower()
+    if raw in ("1", "true", "mosaic"):
+        return "mosaic"
+    if raw == "interpret":
+        return "interpret"
+    return "off"
+
+
 def get_device_codec(entry: SchemaEntry) -> DeviceCodec:
     """Memoized per-schema codec (≙ ``get_or_parse_schema`` + the Arc-shared
-    Arrow schema, ``src/lib.rs:44``/``deserialize.rs:85-89``)."""
-    return entry.get_extra("device_codec", lambda: DeviceCodec(entry))
+    Arrow schema, ``src/lib.rs:44``/``deserialize.rs:85-89``).
+
+    The (normalized) PYRUHVRO_TPU_PALLAS mode is part of the memo key:
+    toggling the flag between calls must yield a codec honoring the new
+    value, not silently return the first-built one (ADVICE r04). The
+    mode is read ONCE here and passed down, so the cached codec always
+    matches its key even if the env mutates mid-construction."""
+    mode = _pallas_mode()
+    return entry.get_extra(
+        f"device_codec:pallas={mode}",
+        lambda: DeviceCodec(entry, pallas=mode),
+    )

@@ -196,10 +196,14 @@ class PallasKernelDecoder:
 
     def _fn(self, grid_r: int, tile_r: int, BW: int):
         key = (grid_r, tile_r, BW)
-        fn = self._cache.get(key)
-        if fn is None:
-            fn = self._build(grid_r, tile_r, BW)
-            with self._lock:
+        # get-or-build under the lock: concurrent callers must not both
+        # compile the same bucket (ADVICE r04 — wasted compile time).
+        # Different buckets serialize their builds too, which is fine:
+        # builds are rare (per shape bucket) and correctness-neutral.
+        with self._lock:
+            fn = self._cache.get(key)
+            if fn is None:
+                fn = self._build(grid_r, tile_r, BW)
                 self._cache[key] = fn
         return fn
 

@@ -1025,8 +1025,19 @@ PyObject* py_encode(PyObject*, PyObject* args) {
 
   PyObject* seq = PySequence_Fast(bufs_obj, "buffers must be a sequence");
   if (!seq) return nullptr;
-  std::vector<BufferGuard> guards(PySequence_Fast_GET_SIZE(seq));
-  std::vector<InCol> cols(ncols);
+  // same tight-memory conditions as the sizes/VecWriter guards below:
+  // a bad_alloc must become MemoryError, never cross the extern-C
+  // boundary into std::terminate
+  std::vector<BufferGuard> guards;
+  std::vector<InCol> cols;
+  try {
+    guards.resize((size_t)PySequence_Fast_GET_SIZE(seq));
+    cols.resize(ncols);
+  } catch (const std::bad_alloc&) {
+    Py_DECREF(seq);
+    PyErr_NoMemory();
+    return nullptr;
+  }
   size_t bi = 0;
   bool ok = true;
   for (size_t c = 0; c < ncols && ok; c++) {
@@ -1072,7 +1083,14 @@ PyObject* py_encode(PyObject*, PyObject* args) {
     return nullptr;
   }
 
-  std::vector<int32_t> sizes((size_t)n);
+  std::vector<int32_t> sizes;
+  try {
+    sizes.resize((size_t)n);
+  } catch (const std::bad_alloc&) {
+    Py_DECREF(seq);
+    PyErr_NoMemory();
+    return nullptr;
+  }
   bool overflow = false;
   bool vm_err = false;
 
@@ -1104,12 +1122,29 @@ PyObject* py_encode(PyObject*, PyObject* args) {
   } else {
     PyErr_Clear();  // bound allocation failed: geometric growth instead
     std::vector<uint8_t> out;
+    bool oom = false;
     Py_BEGIN_ALLOW_THREADS;
-    out.reserve((size_t)n * 32);
-    VecWriter w{&out};
-    run_encode(ops, cols, w, n, sizes.data(), &overflow, &vm_err);
+    // this branch runs exactly when memory is already tight (the eager
+    // bound allocation above failed, or bound > int32) — a bad_alloc
+    // here must become a Python MemoryError, not std::terminate across
+    // the extern-C boundary (ADVICE r04)
+    try {
+      try {
+        out.reserve((size_t)n * 32);
+      } catch (const std::bad_alloc&) {
+        // the reserve is only a pre-size hint; geometric growth remains
+      }
+      VecWriter w{&out};
+      run_encode(ops, cols, w, n, sizes.data(), &overflow, &vm_err);
+    } catch (const std::bad_alloc&) {
+      oom = true;
+    }
     Py_END_ALLOW_THREADS;
     Py_DECREF(seq);
+    if (oom) {
+      PyErr_NoMemory();
+      return nullptr;
+    }
     if (overflow || vm_err) {
       PyErr_SetString(PyExc_OverflowError,
                       overflow ? "encoded batch exceeds int32 binary offsets"

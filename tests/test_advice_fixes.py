@@ -144,3 +144,52 @@ def test_encode_rejects_wrong_type_still():
     )
     with pytest.raises(ValueError, match="Arrow type"):
         encode_record_batch(batch, entry.ir)
+
+
+# ---- round-4 advisor findings ----------------------------------------
+
+
+def test_single_row_batch_too_large_reraises(monkeypatch):
+    """A one-record batch whose encode blows int32 offsets cannot be
+    split; the host encode path must surface BatchTooLarge (the library
+    contract) instead of falling through to the interpreted encoder,
+    which cannot represent it either (ADVICE r04)."""
+    from pyruhvro_tpu.ops import codec as codec_mod
+    from pyruhvro_tpu.ops.decode import BatchTooLarge
+    from pyruhvro_tpu.utils.datagen import KAFKA_SCHEMA_JSON
+
+    entry = get_or_parse_schema(KAFKA_SCHEMA_JSON)
+
+    class FakeNative:
+        def encode(self, batch):
+            raise BatchTooLarge(batch.num_rows, 1 << 40)
+
+    from pyruhvro_tpu.utils.datagen import kafka_style_datums
+
+    batch = decode_to_record_batch(
+        kafka_style_datums(1, seed=3), entry.ir, entry.arrow_schema
+    )
+    monkeypatch.setattr(
+        "pyruhvro_tpu.api._native_host_codec", lambda e: FakeNative()
+    )
+    dc = codec_mod.DeviceCodec(entry)
+    with pytest.raises(BatchTooLarge):
+        dc._host_encode(batch)
+
+
+def test_pallas_flag_in_codec_cache_key(monkeypatch):
+    """Toggling PYRUHVRO_TPU_PALLAS between calls must yield a codec
+    honoring the new value — the flag is part of the memo key
+    (ADVICE r04)."""
+    from pyruhvro_tpu.ops.codec import get_device_codec
+    from pyruhvro_tpu.ops.decode import DeviceDecoder
+    from pyruhvro_tpu.ops.pallas_decode import PallasKernelDecoder
+    from pyruhvro_tpu.utils.datagen import CRITERION_SHAPES
+
+    entry = get_or_parse_schema(CRITERION_SHAPES["flat_primitives"])
+    monkeypatch.delenv("PYRUHVRO_TPU_PALLAS", raising=False)
+    assert isinstance(get_device_codec(entry).decoder, DeviceDecoder)
+    monkeypatch.setenv("PYRUHVRO_TPU_PALLAS", "interpret")
+    assert isinstance(get_device_codec(entry).decoder, PallasKernelDecoder)
+    monkeypatch.delenv("PYRUHVRO_TPU_PALLAS", raising=False)
+    assert isinstance(get_device_codec(entry).decoder, DeviceDecoder)

@@ -150,8 +150,8 @@ def test_pallas_opt_in_api_routing(monkeypatch):
     arr_schema = CRITERION_SHAPES["array_and_map"]
     e = get_or_parse_schema(schema)
     e2 = get_or_parse_schema(arr_schema)
-    e._extras.pop("device_codec", None)  # rebuild under the env flag
-    e2._extras.pop("device_codec", None)
+    # the flag value is part of the memo key (ADVICE r04), so no manual
+    # eviction is needed for the rebuild — the "interpret" key is fresh
     try:
         datums = random_datums(e.ir, 200, seed=77)
         out = deserialize_array_threaded(datums, schema, 4, backend="tpu")
@@ -174,5 +174,5 @@ def test_pallas_opt_in_api_routing(monkeypatch):
     finally:
         # the schema cache is process-wide: codecs built under the env
         # flag must not leak into later tests even when asserts fail
-        e._extras.pop("device_codec", None)
-        e2._extras.pop("device_codec", None)
+        e._extras.pop("device_codec:pallas=interpret", None)
+        e2._extras.pop("device_codec:pallas=interpret", None)
