@@ -40,6 +40,16 @@ class NativeHostCodec:
     the fast subset — callers fall back to the Python decoder for both.
     """
 
+    # Cumulative decoded rows after which a schema is "hot" and earns a
+    # SPECIALIZED decoder: its opcode program is unrolled to straight-
+    # line C++ and compiled (hostpath/specialize.py) — a one-time ~1s
+    # g++ run, disk-cached per machine, the same economics as an XLA
+    # compile. Below the threshold the bytecode VM serves with zero
+    # latency (tests, one-shot scripts). PYRUHVRO_TPU_SPECIALIZE_ROWS=0
+    # forces immediate specialization; PYRUHVRO_TPU_NO_SPECIALIZE=1
+    # pins the interpreter.
+    _SPECIALIZE_ROWS = 20_000
+
     def __init__(self, ir, arrow_schema: pa.Schema):
         self.ir = ir
         self.arrow_schema = arrow_schema
@@ -48,6 +58,30 @@ class NativeHostCodec:
         self._mod = load_host_codec()
         if self._mod is None:
             raise RuntimeError("native host codec unavailable (no toolchain)")
+        import os
+
+        self._spec = None            # the specialized module, once built
+        self._spec_failed = os.environ.get("PYRUHVRO_TPU_NO_SPECIALIZE") == "1"
+        try:
+            self._spec_rows = int(os.environ.get(
+                "PYRUHVRO_TPU_SPECIALIZE_ROWS", self._SPECIALIZE_ROWS))
+        except ValueError:
+            self._spec_rows = self._SPECIALIZE_ROWS
+        self._rows_seen = 0
+
+    def _maybe_specialize(self, n: int) -> None:
+        if self._spec is not None or self._spec_failed:
+            return
+        self._rows_seen += n
+        if self._rows_seen < self._spec_rows:
+            return
+        from .specialize import load_specialized
+
+        mod = load_specialized(self.prog)
+        if mod is None:
+            self._spec_failed = True  # no toolchain / build error: probe once
+        else:
+            self._spec = mod
 
     def decode(self, data: Sequence[bytes],
                nthreads: int = 0, index_base: int = 0) -> pa.RecordBatch:
@@ -58,13 +92,19 @@ class NativeHostCodec:
         from ..runtime import metrics
 
         n = len(data)
+        self._maybe_specialize(n)
         # records decode straight from the caller's bytes objects (span
         # collection in C++, ≙ extract_bytes_list src/lib.rs:29-33) —
         # no concatenation pass exists on this path at all
         with metrics.timer("host.vm_s"):
-            bufs, err_rec, err_bits = self._mod.decode(
-                self.prog.ops, self.prog.coltypes, data, nthreads
-            )
+            if self._spec is not None:
+                bufs, err_rec, err_bits = self._spec.decode(
+                    self.prog.coltypes, data, nthreads
+                )
+            else:
+                bufs, err_rec, err_bits = self._mod.decode(
+                    self.prog.ops, self.prog.coltypes, data, nthreads
+                )
         if err_rec >= 0:
             bit = err_bits & -err_bits
             raise MalformedAvro(

@@ -1,0 +1,329 @@
+"""Schema-specialized native decoders: HostProgram → straight-line C++.
+
+The bytecode VM (``runtime/native/host_codec.cpp``) serves any schema
+with zero compile latency, but pays switch dispatch + tree recursion per
+field per record. This module is the host tier's analogue of XLA's
+compile-once-run-many model: when a schema gets hot (see
+``codec.NativeHostCodec``'s row threshold), its opcode program is
+unrolled into a dedicated C++ translation unit — every column index,
+branch index, enum cardinality and fixed size a compile-time constant,
+no dispatch, no recursion — compiled with the same flags as the VM and
+cached on disk keyed by the generated source (so a schema compiles once
+per machine, ever).
+
+Correctness story: the generated code and the VM execute the SAME
+per-field leaf helpers and the SAME shard/boundary machinery
+(``host_vm_core.h``); only the walk between fields is specialized. The
+generator mirrors ``Vm::exec`` case-for-case, and the differential
+suite runs both engines against the Python oracle
+(``tests/test_specialize.py``).
+
+≙ the role of the reference's monomorphized generics: Rust gets its
+per-schema specialization from the compiler at build time
+(``fast_decode.rs``'s enum dispatch is the part it could NOT
+specialize); this framework generates it per schema at runtime.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+from typing import List, Optional
+
+import numpy as np
+
+from .program import HostProgram
+
+__all__ = ["generate_source", "load_specialized"]
+
+# ops indices (kind, a, b, col, nops, pad) — see hostpath/program.py
+from .program import (  # noqa: E402  (kept near use for readability)
+    OP_RECORD, OP_INT, OP_LONG, OP_FLOAT, OP_DOUBLE, OP_BOOL,
+    OP_STRING, OP_ENUM, OP_NULL, OP_NULLABLE, OP_UNION,
+    OP_ARRAY, OP_MAP, OP_FIXED, OP_DEC_BYTES, OP_DEC_FIXED,
+)
+
+
+class _Gen:
+    """Emit the decode body for one opcode subtree.
+
+    ``present`` threads through as either the literal ``True`` (field is
+    statically reached — the dominant case, which compiles to branchless
+    straight-line reads) or the name of a C ``bool`` local minted by the
+    enclosing nullable/union.
+    """
+
+    def __init__(self, ops: np.ndarray):
+        self.ops = ops
+        self.lines: List[str] = []
+        self.indent = 1
+        self.uid = 0
+        self.cols_used: set = set()
+
+    def w(self, line: str) -> None:
+        self.lines.append("  " * self.indent + line)
+
+    def c(self, col: int) -> str:
+        self.cols_used.add(col)
+        return f"C{col}"
+
+    def fresh(self) -> int:
+        self.uid += 1
+        return self.uid
+
+    def gen(self, pc: int, present) -> int:
+        """Generate code for the subtree at ``pc``; return next pc.
+        Mirrors ``Vm::exec`` (host_codec.cpp) case-for-case."""
+        kind, a, b, col, nops, _pad = (int(x) for x in self.ops[pc])
+        p = "true" if present is True else present
+
+        if kind == OP_RECORD:
+            q = pc + 1
+            stop = pc + nops
+            while q < stop:
+                q = self.gen(q, present)
+            return q
+
+        if kind == OP_INT:
+            v = "(int32_t)r.read_zigzag()"
+            self.w(f"{self.c(col)}.i32.push_back("
+                   + (v if present is True else f"{p} ? {v} : 0") + ");")
+            return pc + 1
+        if kind == OP_LONG:
+            v = "r.read_zigzag()"
+            self.w(f"{self.c(col)}.i64.push_back("
+                   + (v if present is True else f"{p} ? {v} : 0") + ");")
+            return pc + 1
+        if kind in (OP_FLOAT, OP_DOUBLE):
+            ty, nb, fld = (("float", 4, "f32") if kind == OP_FLOAT
+                           else ("double", 8, "f64"))
+            u = self.fresh()
+            self.w(f"{ty} v{u} = 0;")
+            rd = f"r.read_fixed(&v{u}, {nb});"
+            self.w(rd if present is True else f"if ({p}) {rd}")
+            self.w(f"{self.c(col)}.{fld}.push_back(v{u});")
+            return pc + 1
+        if kind == OP_BOOL:
+            u = self.fresh()
+            self.w(f"uint8_t v{u} = 0;")
+            body = (f"if (r.cur >= r.end) r.err |= ERR_OVERRUN; "
+                    f"else {{ v{u} = r.base[r.cur++]; "
+                    f"if (v{u} > 1) r.err |= ERR_BAD_BOOL; }}")
+            self.w(body if present is True else f"if ({p}) {{ {body} }}")
+            self.w(f"{self.c(col)}.u8.push_back(v{u});")
+            return pc + 1
+        if kind == OP_STRING:
+            self.w(f"rd_string({self.c(col)}, r, {p});")
+            return pc + 1
+        if kind == OP_FIXED:
+            self.w(f"rd_fixed({self.c(col)}, r, {p}, {a});")
+            return pc + 1
+        if kind == OP_DEC_BYTES:
+            self.w(f"rd_decimal({self.c(col)}, r, {p}, -1);")
+            return pc + 1
+        if kind == OP_DEC_FIXED:
+            self.w(f"rd_decimal({self.c(col)}, r, {p}, {a});")
+            return pc + 1
+        if kind == OP_ENUM:
+            u = self.fresh()
+            self.w(f"int64_t v{u} = 0;")
+            body = (f"v{u} = r.read_zigzag(); "
+                    f"if (v{u} < 0 || v{u} >= {a}) "
+                    f"{{ r.err |= ERR_BAD_ENUM; v{u} = 0; }}")
+            self.w(body if present is True else f"if ({p}) {{ {body} }}")
+            self.w(f"{self.c(col)}.i32.push_back((int32_t)v{u});")
+            return pc + 1
+        if kind == OP_NULL:
+            return pc + 1
+
+        if kind == OP_NULLABLE:
+            u = self.fresh()
+            self.w(f"uint8_t valid{u} = 0; bool p{u} = false;")
+            body = (f"int64_t br{u} = r.read_zigzag(); "
+                    f"if (br{u} == {1 - a}) "
+                    f"{{ valid{u} = 1; p{u} = true; }} "
+                    f"else if (br{u} != {a}) r.err |= ERR_BAD_BRANCH;")
+            self.w("{ " + body + " }" if present is True
+                   else f"if ({p}) {{ {body} }}")
+            self.w(f"{self.c(col)}.u8.push_back(valid{u});")
+            return self.gen(pc + 1, f"p{u}")
+
+        if kind == OP_UNION:
+            u = self.fresh()
+            self.w(f"int32_t tid{u} = 0;")
+            body = (f"int64_t br{u} = r.read_zigzag(); "
+                    f"if (br{u} < 0 || br{u} >= {a}) "
+                    f"{{ r.err |= ERR_BAD_BRANCH; br{u} = 0; }} "
+                    f"tid{u} = (int32_t)br{u};")
+            self.w("{ " + body + " }" if present is True
+                   else f"if ({p}) {{ {body} }}")
+            self.w(f"{self.c(col)}.i32.push_back(tid{u});")
+            q = pc + 1
+            for k in range(a):
+                sel = (f"tid{u} == {k}" if present is True
+                       else f"{p} && tid{u} == {k}")
+                v = self.fresh()
+                self.w(f"bool p{v} = {sel};")
+                q = self.gen(q, f"p{v}")
+            return q
+
+        if kind in (OP_ARRAY, OP_MAP):
+            u = self.fresh()
+            offs = self.c(col)
+            self.w("{")
+            self.indent += 1
+            opened = present is not True
+            if opened:
+                self.w(f"if ({p}) {{")
+                self.indent += 1
+            # ≙ Vm::decode_blocks — same checks in the same order
+            self.w("for (;;) {")
+            self.indent += 1
+            self.w(f"if (r.err) goto blk{u}_done;")
+            self.w(f"int64_t cnt{u} = r.read_zigzag();")
+            self.w(f"if (r.err || cnt{u} == 0) goto blk{u}_done;")
+            self.w(f"if (cnt{u} < 0) {{ cnt{u} = -cnt{u}; "
+                   f"(void)r.read_raw_varint(); "
+                   f"if (r.err) goto blk{u}_done; }}")
+            self.w(f"for (int64_t i{u} = 0; i{u} < cnt{u}; i{u}++) {{")
+            self.indent += 1
+            self.w(f"if (r.err) goto blk{u}_done;")
+            self.w(f"if (r.cur > r.end) "
+                   f"{{ r.err |= ERR_OVERRUN; goto blk{u}_done; }}")
+            if kind == OP_MAP:
+                self.w(f"rd_string({self.c(b)}, r, true);")
+                self.w(f"if (r.err) goto blk{u}_done;")
+            inner_end = self.gen(pc + 1, True)
+            self.w(f"{offs}.running++;")
+            self.w(f"if ({offs}.running < 0) "
+                   f"{{ r.err |= ERR_OVERRUN; goto blk{u}_done; }}")
+            self.indent -= 1
+            self.w("}")
+            self.indent -= 1
+            self.w("}")
+            self.w(f"blk{u}_done:;")
+            if opened:
+                self.indent -= 1
+                self.w("}")
+            self.indent -= 1
+            self.w("}")
+            self.w(f"{offs}.i32.push_back({offs}.running);")
+            return inner_end
+
+        raise AssertionError(f"unknown op kind {kind}")  # pragma: no cover
+
+
+_TEMPLATE = """\
+// AUTO-GENERATED by pyruhvro_tpu.hostpath.specialize — DO NOT EDIT.
+// One schema's HostProgram unrolled into straight-line C++ over the
+// shared decode core (host_vm_core.h). Regenerated whenever the
+// program or the core changes (content-hashed module name).
+#include "{core}"
+
+namespace {{
+using namespace pyr;
+
+inline void decode_record(Reader& r, std::vector<Col>& cols) {{
+{col_refs}
+{body}
+}}
+
+PyObject* py_decode_spec(PyObject*, PyObject* args) {{
+  PyObject *coltypes_obj, *list_obj;
+  int nthreads = 0;
+  if (!PyArg_ParseTuple(args, "OO|i", &coltypes_obj, &list_obj, &nthreads))
+    return nullptr;
+  return decode_boundary(
+      [](Reader& r, std::vector<Col>& cols) {{ decode_record(r, cols); }},
+      coltypes_obj, list_obj, nthreads);
+}}
+
+PyMethodDef methods[] = {{
+    {{"decode", py_decode_spec, METH_VARARGS,
+     "decode(coltypes, data, nthreads=0) -> (buffers, err_record, err_bits)"}},
+    {{nullptr, nullptr, 0, nullptr}},
+}};
+
+PyModuleDef moduledef = {{
+    PyModuleDef_HEAD_INIT, "{mod}",
+    "schema-specialized Avro decoder", -1, methods,
+}};
+
+}}  // namespace
+
+extern "C" PyMODINIT_FUNC PyInit_{mod}(void) {{
+  return PyModule_Create(&moduledef);
+}}
+"""
+
+
+def generate_source(prog: HostProgram, mod_name: str,
+                    core_include: str = "../host_vm_core.h") -> str:
+    """The C++ translation unit for one schema's decoder."""
+    g = _Gen(prog.ops)
+    g.gen(0, True)
+    col_refs = "\n".join(
+        f"  Col& C{c} = cols[{c}];" for c in sorted(g.cols_used)
+    )
+    return _TEMPLATE.format(
+        core=core_include,
+        mod=mod_name,
+        col_refs=col_refs,
+        body="\n".join(g.lines),
+    )
+
+
+def _native_dir() -> str:
+    from ..runtime.native import build as nb
+
+    return nb._HERE
+
+
+def load_specialized(prog: HostProgram):
+    """Generate + compile + import this program's specialized decoder.
+
+    Returns the extension module (its ``decode(coltypes, data,
+    nthreads)`` matches the interpreter's minus the ops argument), or
+    ``None`` when the toolchain is unavailable or the build fails —
+    callers keep the interpreter. Disk-cached: the module name is a
+    content hash of the generated source AND the shared core header, so
+    any change to either regenerates, and repeat processes just dlopen.
+    """
+    from ..runtime.native import build as nb
+
+    spec_dir = os.path.join(_native_dir(), "_spec")
+    try:
+        core_path = os.path.join(_native_dir(), "host_vm_core.h")
+        with open(core_path) as f:
+            core_text = f.read()
+        probe = generate_source(prog, "M")  # name-independent content
+        h = hashlib.sha256(
+            (probe + "\x00" + core_text).encode()
+        ).hexdigest()[:12]
+        mod_name = f"_pyruhvro_spec_{h}"
+        if mod_name in nb._modules:
+            return nb._modules[mod_name]
+        with nb._lock:
+            if mod_name in nb._modules:
+                return nb._modules[mod_name]
+            os.makedirs(spec_dir, exist_ok=True)
+            src = os.path.join(spec_dir, mod_name + ".cpp")
+            so = os.path.join(
+                spec_dir, mod_name + nb._ext_suffix()
+            )
+            if not os.path.exists(src):
+                tmp = f"{src}.{os.getpid()}.tmp"
+                with open(tmp, "w") as f:
+                    f.write(generate_source(prog, mod_name))
+                os.replace(tmp, src)
+            if nb._needs_build(so, src):
+                nb._compile(so, src)
+            import importlib.util
+
+            spec = importlib.util.spec_from_file_location(mod_name, so)
+            mod = importlib.util.module_from_spec(spec)
+            spec.loader.exec_module(mod)
+            nb._modules[mod_name] = mod
+            return mod
+    except Exception:
+        return None

@@ -42,7 +42,7 @@ def _validity(valid: Optional[np.ndarray], count: int):
     """(buffer, null_count) for an optional boolean lane vector."""
     if valid is None:
         return None, 0
-    nulls = count - int(valid.sum())
+    nulls = count - int(np.count_nonzero(valid))
     if nulls == 0:
         return None, 0
     return pa.py_buffer(np.packbits(valid, bitorder="little")), nulls
@@ -99,6 +99,17 @@ def _combine64(lo: np.ndarray, hi: np.ndarray, view) -> np.ndarray:
 _UUID_KEEP = np.delete(np.arange(36), [8, 13, 18, 23])
 
 
+def _native_cumsum():
+    """The loaded native module IF it carries ``cumsum0`` — one shared
+    predicate, so capacity-guard sites can rely on exactly the same
+    condition ``cumsum0`` dispatches on (a stale .so without the symbol
+    must make BOTH fall back together, or the int32 guard is lost)."""
+    from ..runtime.native import build as _nb
+
+    mod = _nb._modules.get("_pyruhvro_hostcodec")
+    return mod if mod is not None and hasattr(mod, "cumsum0") else None
+
+
 def cumsum0(lens: np.ndarray) -> np.ndarray:
     """Arrow offsets (leading 0) from an int32 length vector.
 
@@ -108,10 +119,8 @@ def cumsum0(lens: np.ndarray) -> np.ndarray:
     hot path — a device-only process may legitimately have no .so).
     Callers guard the int32 total themselves; the native path would
     raise OverflowError, the numpy path would wrap."""
-    from ..runtime.native import build as _nb
-
-    mod = _nb._modules.get("_pyruhvro_hostcodec")
-    if mod is not None and hasattr(mod, "cumsum0"):
+    mod = _native_cumsum()
+    if mod is not None:
         return np.frombuffer(
             mod.cumsum0(np.ascontiguousarray(lens, np.int32)), np.int32
         )
@@ -227,13 +236,26 @@ class _Assembler:
         original datum bytes — with the 2 GiB int32-offset guard (the
         oracle's ``pa.array`` raises the same error class)."""
         lens = self.host[path + "#len"][:count]
-        total = int(lens.sum(dtype=np.int64))
-        if total >= (1 << 31):
-            raise pa.lib.ArrowCapacityError(
-                f"column {path!r} carries {total} value bytes — over "
-                f"the 2 GiB Binary/Utf8 capacity; split the batch"
-            )
-        voff = cumsum0(lens)  # capacity-checked above
+        # the native cumsum0 raises OverflowError past int32 itself, so
+        # the common path needs no separate whole-column sum; the numpy
+        # fallback would wrap silently and keeps the explicit guard
+        if _native_cumsum() is not None:
+            try:
+                voff = cumsum0(lens)
+            except OverflowError:
+                raise pa.lib.ArrowCapacityError(
+                    f"column {path!r} carries over 2 GiB of value bytes "
+                    f"— over the Binary/Utf8 capacity; split the batch"
+                ) from None
+            total = int(voff[-1]) if len(voff) else 0
+        else:
+            total = int(lens.sum(dtype=np.int64))
+            if total >= (1 << 31):
+                raise pa.lib.ArrowCapacityError(
+                    f"column {path!r} carries {total} value bytes — over "
+                    f"the 2 GiB Binary/Utf8 capacity; split the batch"
+                )
+            voff = cumsum0(lens)
         if path + "#bytes" in self.host:
             values = self.host[path + "#bytes"][:total]
         else:
@@ -440,6 +462,25 @@ class _Assembler:
         idx = self.col(path + "#v", count)
         sym_bytes = np.frombuffer("".join(t.symbols).encode("utf-8"), np.uint8)
         sym_lens = np.array([len(s.encode("utf-8")) for s in t.symbols], np.int32)
+        if count and int(sym_lens.max()) == int(sym_lens.min()):
+            # uniform symbol width L (the typical enum): offsets are a
+            # ramp and the values one (count, L) table gather — replaces
+            # the repeat/arange expansion below (~4x on this hot cell)
+            L = int(sym_lens[0])
+            if count * L >= (1 << 31):
+                raise pa.lib.ArrowCapacityError(
+                    f"enum column {path!r} expands to {count * L} symbol "
+                    f"bytes — over the 2 GiB Utf8 capacity; split the batch"
+                )
+            offsets = (np.arange(count + 1, dtype=np.int64) * L).astype(
+                np.int32
+            )
+            values = sym_bytes.reshape(len(t.symbols), L)[idx].reshape(-1)
+            return pa.Array.from_buffers(
+                pa.utf8(), count,
+                [vbuf, pa.py_buffer(offsets), pa.py_buffer(values)],
+                null_count=nulls,
+            )
         sym_starts = np.zeros(len(t.symbols), np.int32)
         np.cumsum(sym_lens[:-1], out=sym_starts[1:])
         lens = sym_lens[idx]

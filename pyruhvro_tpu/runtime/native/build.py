@@ -20,9 +20,12 @@ _lock = threading.Lock()
 _modules: dict = {}
 
 
+def _ext_suffix() -> str:
+    return sysconfig.get_config_var("EXT_SUFFIX") or ".so"
+
+
 def _so_path(mod_name: str) -> str:
-    suffix = sysconfig.get_config_var("EXT_SUFFIX") or ".so"
-    return os.path.join(_HERE, mod_name + suffix)
+    return os.path.join(_HERE, mod_name + _ext_suffix())
 
 
 def _cpu_tag() -> str:
@@ -48,7 +51,12 @@ def _cpu_tag() -> str:
 
 
 def _needs_build(so: str, src: str) -> bool:
-    if (not os.path.exists(so)) or os.path.getmtime(so) < os.path.getmtime(src):
+    src_mtime = os.path.getmtime(src)
+    # editing the shared core header must rebuild its includers too
+    hdr = os.path.join(_HERE, "host_vm_core.h")
+    if os.path.exists(hdr):
+        src_mtime = max(src_mtime, os.path.getmtime(hdr))
+    if (not os.path.exists(so)) or os.path.getmtime(so) < src_mtime:
         return True
     try:
         with open(so + ".buildinfo") as f:

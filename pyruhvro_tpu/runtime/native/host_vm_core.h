@@ -1,0 +1,672 @@
+// Shared core of the native host codec: wire reader, columnar
+// builders, shard runner and the Python decode boundary — everything
+// that is identical between the generic bytecode VM
+// (host_codec.cpp) and the schema-SPECIALIZED decoders that
+// hostpath/specialize.py generates (straight-line C++ per schema,
+// compiled on demand and cached). Keeping one definition here is what
+// makes the specializer trustworthy: both engines read the wire and
+// fill columns through these exact helpers, so the differential suite
+// covers them jointly.
+//
+// Everything is header-only (inline / template): each extension module
+// (the interpreter's and every generated one) compiles its own copy.
+//
+// Behavior parity anchors (see host_codec.cpp's header comment):
+// zigzag varints ≙ ruhvro/src/fast_decode.rs:855-869; block protocol
+// ≙ fast_decode.rs:689-700; error bits ≙ ops/varint.py ERR_*.
+#ifndef PYRUHVRO_HOST_VM_CORE_H_
+#define PYRUHVRO_HOST_VM_CORE_H_
+
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+#include <new>
+#include <thread>
+#include <utility>
+#include <vector>
+
+namespace pyr {
+
+// ---- op kinds (keep in sync with hostpath/program.py) ----------------
+enum OpKind : int32_t {
+  OP_RECORD = 0,
+  OP_INT = 1,
+  OP_LONG = 2,
+  OP_FLOAT = 3,
+  OP_DOUBLE = 4,
+  OP_BOOL = 5,
+  OP_STRING = 6,
+  OP_ENUM = 7,
+  OP_NULL = 8,
+  OP_NULLABLE = 9,
+  OP_UNION = 10,
+  OP_ARRAY = 11,
+  OP_MAP = 12,
+  OP_FIXED = 13,      // a = byte size; col = raw bytes (size per entry)
+  OP_DEC_BYTES = 14,  // decimal over bytes; col = 16B LE words
+  OP_DEC_FIXED = 15,  // a = byte size; decimal over fixed; col = 16B LE
+};
+
+// ---- column types (keep in sync with hostpath/program.py) ------------
+enum ColType : int32_t {
+  COL_I32 = 0,   // one int32 buffer
+  COL_I64 = 1,   // one int64 buffer
+  COL_F32 = 2,
+  COL_F64 = 3,
+  COL_U8 = 4,
+  COL_STR = 5,   // two buffers: value bytes uint8, len int32
+  COL_OFFS = 6,  // one int32 buffer of running totals (no leading 0)
+};
+
+// ---- error bits (keep in sync with ops/varint.py) --------------------
+enum Err : int32_t {
+  ERR_VARINT = 1 << 0,
+  ERR_NEG_LEN = 1 << 1,
+  ERR_OVERRUN = 1 << 2,
+  ERR_BAD_BRANCH = 1 << 3,
+  ERR_BAD_ENUM = 1 << 4,
+  ERR_TRAILING = 1 << 5,
+  ERR_BAD_BOOL = 1 << 6,
+  ERR_DEC_RANGE = 1 << 8,  // decimal outside decimal128's 128-bit range
+};
+
+struct Op {
+  int32_t kind;
+  int32_t a;     // kind-specific: null_idx / n_variants / n_symbols
+  int32_t b;     // kind-specific: map key col
+  int32_t col;   // primary output column (-1 = none)
+  int32_t nops;  // ops in this subtree, self included
+  int32_t pad;
+};
+
+// Growable byte buffer for the u8 builders (string values, validity,
+// fixed, decimal words). Replaces std::vector<uint8_t> for two wins
+// measured on the kafka workload: (a) a guaranteed 16-byte headroom
+// past ``n`` lets short appends compile to ONE fixed-size 16-byte copy
+// (two SIMD moves, no libc memmove call) — most real string fields are
+// under 16 bytes; (b) growth uses realloc, which commonly extends in
+// place where vector must allocate+copy+free.
+struct ByteBuf {
+  uint8_t* p = nullptr;
+  size_t n = 0;
+  size_t cap = 0;  // usable bytes; allocation is cap + 16 headroom
+
+  ByteBuf() = default;
+  ByteBuf(const ByteBuf&) = delete;
+  ByteBuf& operator=(const ByteBuf&) = delete;
+  ByteBuf(ByteBuf&& o) noexcept : p(o.p), n(o.n), cap(o.cap) {
+    o.p = nullptr;
+    o.n = o.cap = 0;
+  }
+  ByteBuf& operator=(ByteBuf&& o) noexcept {
+    if (this != &o) {
+      std::free(p);
+      p = o.p;
+      n = o.n;
+      cap = o.cap;
+      o.p = nullptr;
+      o.n = o.cap = 0;
+    }
+    return *this;
+  }
+  ~ByteBuf() { std::free(p); }
+
+  inline size_t size() const { return n; }
+  inline const uint8_t* data() const { return p; }
+
+  void grow(size_t need) {  // out of line of the hot paths
+    size_t nc = cap ? cap : 64;
+    while (nc < need) nc *= 2;
+    void* np = std::realloc(p, nc + 16);
+    if (np == nullptr) throw std::bad_alloc();
+    p = static_cast<uint8_t*>(np);
+    cap = nc;
+  }
+  inline void reserve(size_t want) {
+    if (want > cap) grow(want);
+  }
+  inline void ensure(size_t extra) {
+    if (n + extra > cap) grow(n + extra);
+  }
+  inline void push_back(uint8_t b) {
+    ensure(1);
+    p[n++] = b;
+  }
+  // caller guarantees 16 readable bytes at ``s`` (len <= 16): one wide
+  // copy into the headroom, no branch on len
+  inline void append_wide16(const uint8_t* s, size_t len) {
+    ensure(len);
+    std::memcpy(p + n, s, 16);
+    n += len;
+  }
+  inline void append(const uint8_t* s, size_t len) {
+    ensure(len);
+    std::memcpy(p + n, s, len);
+    n += len;
+  }
+  inline void append_fill(size_t len, uint8_t v) {
+    ensure(len);
+    std::memset(p + n, v, len);
+    n += len;
+  }
+};
+
+struct Col {
+  int32_t type = 0;
+  ByteBuf u8;
+  std::vector<int32_t> i32;
+  std::vector<int64_t> i64;  // COL_I64 values / COL_STR starts
+  std::vector<float> f32;
+  std::vector<double> f64;
+  int32_t running = 0;  // COL_OFFS running item total
+};
+
+struct Reader {
+  const uint8_t* base;  // flat buffer start
+  int64_t cur;          // global cursor
+  int64_t end;          // record end (global)
+  int32_t err = 0;
+
+  inline uint64_t read_raw_varint() {
+    // 1-byte fast path: the overwhelmingly common case on real data
+    // (branch indices, block counts, short lengths, small ints)
+    if (cur < end) {
+      uint8_t b0 = base[cur];
+      if (b0 < 0x80) {
+        cur++;
+        return b0;
+      }
+      if (end - cur >= 10) {  // full wire max in-span: no per-byte bounds
+        const uint8_t* p = base + cur;
+        uint64_t v = b0 & 0x7F;
+        int shift = 7;
+        for (int k = 1; k < 10; k++) {
+          uint8_t byte = p[k];
+          v |= (uint64_t)(byte & 0x7F) << shift;
+          if (byte < 0x80) {
+            cur += k + 1;
+            return v;
+          }
+          shift += 7;
+        }
+        err |= ERR_VARINT;
+        return 0;
+      }
+    }
+    // tail path: per-byte bounds near the record end
+    uint64_t v = 0;
+    int shift = 0;
+    for (int k = 0; k < 10; k++) {
+      if (cur >= end) {
+        err |= ERR_OVERRUN;
+        return 0;
+      }
+      uint8_t byte = base[cur++];
+      v |= (uint64_t)(byte & 0x7F) << shift;
+      if (byte < 0x80) return v;
+      shift += 7;
+    }
+    err |= ERR_VARINT;
+    return 0;
+  }
+
+  inline int64_t read_zigzag() {
+    uint64_t u = read_raw_varint();
+    return (int64_t)(u >> 1) ^ -(int64_t)(u & 1);
+  }
+
+  inline bool read_fixed(void* dst, size_t nbytes) {
+    if (cur + (int64_t)nbytes > end) {
+      err |= ERR_OVERRUN;
+      return false;
+    }
+    std::memcpy(dst, base + cur, nbytes);
+    cur += (int64_t)nbytes;
+    return true;
+  }
+};
+
+// ---- per-field decode leaves (shared by VM and generated code) -------
+
+// String: length varint + raw bytes copied into the column's byte
+// buffer while they are cache-hot (the Python assembler would
+// otherwise re-gather them with a 3-pass numpy fancy-index).
+inline void rd_string(Col& c, Reader& r, bool present) {
+  int64_t len = 0;
+  if (present) {
+    len = r.read_zigzag();
+    if (len < 0) {
+      r.err |= ERR_NEG_LEN;
+      len = 0;
+    }
+    // compare against the REMAINING span: `cur + len` would overflow
+    // int64 for a crafted ~2^63 length and dodge the check
+    if (len > r.end - r.cur) {
+      r.err |= ERR_OVERRUN;
+      len = 0;
+    }
+    if (len) {
+      if (len <= 16 && r.end - r.cur >= 16)
+        c.u8.append_wide16(r.base + r.cur, (size_t)len);
+      else
+        c.u8.append(r.base + r.cur, (size_t)len);
+      r.cur += len;
+    }
+  }
+  c.i32.push_back((int32_t)len);
+}
+
+inline void rd_fixed(Col& c, Reader& r, bool present, int64_t nsz) {
+  if (present && nsz <= r.end - r.cur) {
+    c.u8.append(r.base + r.cur, (size_t)nsz);
+    r.cur += nsz;
+  } else {
+    if (present) r.err |= ERR_OVERRUN;
+    c.u8.append_fill((size_t)nsz, 0);  // keep lengths aligned
+  }
+}
+
+// Decimal over bytes (fixed_size < 0: length-prefixed) or over fixed
+// (fixed_size = wire size): big-endian two's complement of any length
+// (non-minimal and over-long sign-extended forms accepted like the
+// oracle's int.from_bytes) -> one 16-byte LE decimal128 word.
+inline void rd_decimal(Col& c, Reader& r, bool present, int64_t fixed_size) {
+  int64_t len = 0;
+  if (present) {
+    if (fixed_size < 0) {
+      len = r.read_zigzag();
+      if (len < 0) {
+        r.err |= ERR_NEG_LEN;
+        len = 0;
+      }
+    } else {
+      len = fixed_size;
+    }
+    if (len > r.end - r.cur) {
+      r.err |= ERR_OVERRUN;
+      len = 0;
+    }
+  }
+  uint8_t out16[16];
+  uint8_t fill = (len > 0 && (r.base[r.cur] & 0x80)) ? 0xFF : 0x00;
+  std::memset(out16, fill, 16);
+  int64_t take = len < 16 ? len : 16;
+  for (int64_t i = 0; i < take; i++)
+    out16[i] = r.base[r.cur + len - 1 - i];
+  if (len > 16) {
+    for (int64_t i = 0; i + 16 < len; i++)
+      if (r.base[r.cur + i] != fill) r.err |= ERR_DEC_RANGE;
+    if (((out16[15] & 0x80) ? 0xFF : 0x00) != fill) r.err |= ERR_DEC_RANGE;
+  }
+  r.cur += present ? len : 0;
+  c.u8.append(out16, 16);
+}
+
+// ---- Python list[bytes] span collection (GIL held) -------------------
+
+struct Span {
+  const uint8_t* ptr;
+  Py_ssize_t len;
+};
+
+inline bool collect_spans(PyObject* seq, std::vector<Span>& spans,
+                          std::vector<Py_buffer>& views,
+                          std::vector<PyObject*>& pins) {
+  Py_ssize_t n = PySequence_Fast_GET_SIZE(seq);
+  spans.reserve((size_t)n);
+  PyObject** items = PySequence_Fast_ITEMS(seq);
+  for (Py_ssize_t i = 0; i < n; i++) {
+    PyObject* item = items[i];
+    if (PyBytes_Check(item)) {
+      // pin the bytes object: the caller's list can be mutated by
+      // another Python thread while the GIL is released below, and the
+      // list is the only thing keeping these borrowed pointers alive
+      Py_INCREF(item);
+      pins.push_back(item);
+      spans.push_back({reinterpret_cast<const uint8_t*>(
+                           PyBytes_AS_STRING(item)),
+                       PyBytes_GET_SIZE(item)});
+    } else {
+      Py_buffer view;  // holds its own reference until released
+      if (PyObject_GetBuffer(item, &view, PyBUF_SIMPLE) != 0) {
+        PyErr_Format(PyExc_TypeError, "record %zd is not bytes-like", i);
+        return false;
+      }
+      views.push_back(view);
+      spans.push_back({static_cast<const uint8_t*>(view.buf), view.len});
+    }
+  }
+  return true;
+}
+
+inline void release_spans(std::vector<Py_buffer>& views,
+                          std::vector<PyObject*>& pins) {
+  for (auto& v : views) PyBuffer_Release(&v);
+  for (auto* p : pins) Py_DECREF(p);
+}
+
+struct ShardResult {
+  std::vector<Col> cols;
+  int64_t err_record = -1;
+  int32_t err_bits = 0;
+};
+
+// The single place that maps a column builder to its raw output bytes
+// (``which`` selects COL_STR's second buffer, the lens).
+inline const void* col_data(const Col& col, int32_t ty, int which,
+                            size_t* nbytes) {
+  switch (ty) {
+    case COL_I32:
+    case COL_OFFS:
+      *nbytes = col.i32.size() * 4;
+      return col.i32.data();
+    case COL_I64:
+      *nbytes = col.i64.size() * 8;
+      return col.i64.data();
+    case COL_F32:
+      *nbytes = col.f32.size() * 4;
+      return col.f32.data();
+    case COL_F64:
+      *nbytes = col.f64.size() * 8;
+      return col.f64.data();
+    case COL_U8:
+      *nbytes = col.u8.size();
+      return col.u8.data();
+    case COL_STR:
+      if (which == 1) {
+        *nbytes = col.i32.size() * 4;
+        return col.i32.data();
+      }
+      *nbytes = col.u8.size();
+      return col.u8.data();
+  }
+  *nbytes = 0;
+  return nullptr;
+}
+
+// One result buffer for column ``c``: allocated at the summed size and
+// filled per shard — no intermediate merge vectors for any shard count.
+// COL_OFFS running totals rebase during the copy.
+inline PyObject* build_col_buffer(const std::vector<ShardResult>& shards,
+                                  size_t c, int32_t ty, int which) {
+  size_t total = 0, nb = 0;
+  for (auto& s : shards) {
+    col_data(s.cols[c], ty, which, &nb);
+    total += nb;
+  }
+  PyObject* out = PyBytes_FromStringAndSize(nullptr, (Py_ssize_t)total);
+  if (!out) return nullptr;
+  char* dst = PyBytes_AS_STRING(out);
+  int64_t base = 0;
+  for (auto& s : shards) {
+    const Col& col = s.cols[c];
+    const void* src = col_data(col, ty, which, &nb);
+    if (ty == COL_OFFS && base) {
+      const int32_t* sp = static_cast<const int32_t*>(src);
+      int32_t* dp = reinterpret_cast<int32_t*>(dst);
+      for (size_t i = 0; i < nb / 4; i++) {
+        int64_t v = base + (int64_t)sp[i];
+        if (v > INT32_MAX) {
+          Py_DECREF(out);
+          PyErr_SetString(PyExc_OverflowError,
+                          "item total exceeds int32 offsets");
+          return nullptr;
+        }
+        dp[i] = (int32_t)v;
+      }
+    } else if (nb) {
+      std::memcpy(dst, src, nb);
+    }
+    dst += nb;
+    if (ty == COL_OFFS) base += (int64_t)col.running;
+  }
+  return out;
+}
+
+// Per-column element-count profile of a decoded shard, used to scale
+// reserves for the real pass (see the sampling block in decode_boundary).
+struct ColProfile {
+  std::vector<int64_t> i32n, i64n, f32n, f64n, u8n;
+};
+
+inline void profile_of(const ShardResult& s, ColProfile* p) {
+  size_t n = s.cols.size();
+  p->i32n.resize(n);
+  p->i64n.resize(n);
+  p->f32n.resize(n);
+  p->f64n.resize(n);
+  p->u8n.resize(n);
+  for (size_t c = 0; c < n; c++) {
+    p->i32n[c] = (int64_t)s.cols[c].i32.size();
+    p->i64n[c] = (int64_t)s.cols[c].i64.size();
+    p->f32n[c] = (int64_t)s.cols[c].f32.size();
+    p->f64n[c] = (int64_t)s.cols[c].f64.size();
+    p->u8n[c] = (int64_t)s.cols[c].u8.size();
+  }
+}
+
+inline int pick_threads(int64_t nrows, int requested) {
+  if (requested > 0) return requested;
+  unsigned hw = std::thread::hardware_concurrency();
+  int maxt = (int)(hw ? (hw > 16 ? 16 : hw) : 1);
+  // ~4k rows per shard minimum: merging has per-shard fixed cost
+  int by_rows = (int)(nrows / 4096);
+  int t = by_rows < maxt ? by_rows : maxt;
+  return t < 1 ? 1 : t;
+}
+
+struct BufferGuard {
+  Py_buffer view{};
+  bool held = false;
+  ~BufferGuard() {
+    if (held) PyBuffer_Release(&view);
+  }
+  bool acquire(PyObject* obj, const char* what) {
+    if (PyObject_GetBuffer(obj, &view, PyBUF_SIMPLE) != 0) {
+      PyErr_Format(PyExc_TypeError, "%s must be a contiguous buffer", what);
+      return false;
+    }
+    held = true;
+    return true;
+  }
+};
+
+inline PyObject* bytes_from(const void* p, size_t nbytes) {
+  return PyBytes_FromStringAndSize(static_cast<const char*>(p),
+                                   (Py_ssize_t)nbytes);
+}
+
+// ---- shard runner + Python boundary, generic over the decoder --------
+//
+// ``RecFn`` decodes ONE record: void(Reader&, std::vector<Col>&). The
+// interpreter passes a lambda running its bytecode VM; a generated
+// module passes its schema-specialized straight-line function. Must be
+// copyable and thread-safe (pure function of the wire bytes).
+
+// err_record = -2 in a ShardResult marks an allocation failure (mapped
+// to MemoryError at the boundary), never a wire error.
+template <class RecFn>
+inline void run_shard_t(RecFn rec, const int32_t* coltypes, size_t ncols,
+                        const Span* spans, int64_t row_a, int64_t row_b,
+                        ShardResult* out, const ColProfile* prof = nullptr,
+                        double scale = 0.0) try {
+  out->cols.resize(ncols);
+  int64_t nrows = row_b - row_a;
+  for (size_t c = 0; c < ncols; c++) {
+    Col& col = out->cols[c];
+    col.type = coltypes[c];
+    if (prof != nullptr) {
+      // reserves scaled from a sampled row range: growing a multi-
+      // hundred-MB vector memcpies its whole payload per doubling, so
+      // giant batches must land near their final sizes up front
+      col.i32.reserve((size_t)(prof->i32n[c] * scale) + 16);
+      col.i64.reserve((size_t)(prof->i64n[c] * scale) + 16);
+      col.f32.reserve((size_t)(prof->f32n[c] * scale) + 16);
+      col.f64.reserve((size_t)(prof->f64n[c] * scale) + 16);
+      col.u8.reserve((size_t)(prof->u8n[c] * scale) + 16);
+      continue;
+    }
+    switch (col.type) {  // row-region columns get exact reserves; item
+      case COL_I32:      // columns grow amortized
+      case COL_OFFS:
+        col.i32.reserve((size_t)nrows);
+        break;
+      case COL_I64:
+        col.i64.reserve((size_t)nrows);
+        break;
+      case COL_F32:
+        col.f32.reserve((size_t)nrows);
+        break;
+      case COL_F64:
+        col.f64.reserve((size_t)nrows);
+        break;
+      case COL_U8:
+        col.u8.reserve((size_t)nrows);
+        break;
+      case COL_STR:
+        col.u8.reserve((size_t)nrows * 12);  // typical short strings
+        col.i32.reserve((size_t)nrows);
+        break;
+    }
+  }
+  for (int64_t i = row_a; i < row_b; i++) {
+    Reader r{spans[i].ptr, 0, spans[i].len, 0};
+    rec(r, out->cols);
+    if (!r.err && r.cur != r.end) r.err |= ERR_TRAILING;
+    if (r.err) {
+      out->err_record = i;
+      out->err_bits = r.err;
+      return;
+    }
+  }
+} catch (const std::bad_alloc&) {
+  out->err_record = -2;
+}
+
+// decode boundary: (coltypes, data_list, nthreads) with the decoder
+// supplied by the caller -> (buffers: list[bytes], err_record, err_bits)
+// ``data_list`` is the caller's list[bytes] — records decode straight
+// from the original Python buffers (span collection under the GIL, like
+// the packer shim), so no host-side concatenation pass or flat copy
+// exists at all. Buffer order: for each column in order — COL_STR
+// contributes two entries (value bytes uint8, len int32); others one.
+// COL_OFFS buffers carry running totals only; Python prepends the 0.
+template <class RecFn>
+inline PyObject* decode_boundary(RecFn rec, PyObject* coltypes_obj,
+                                 PyObject* list_obj, int nthreads) {
+  BufferGuard ct_b;
+  if (!ct_b.acquire(coltypes_obj, "coltypes")) return nullptr;
+  const int32_t* coltypes = static_cast<const int32_t*>(ct_b.view.buf);
+  size_t ncols = (size_t)(ct_b.view.len / sizeof(int32_t));
+
+  PyObject* seq = PySequence_Fast(list_obj, "data must be a sequence");
+  if (!seq) return nullptr;
+  Py_ssize_t n = PySequence_Fast_GET_SIZE(seq);
+  std::vector<Span> spans;
+  std::vector<Py_buffer> views;
+  std::vector<PyObject*> pins;
+  if (!collect_spans(seq, spans, views, pins)) {
+    release_spans(views, pins);
+    Py_DECREF(seq);
+    return nullptr;
+  }
+
+  int nt = pick_threads(n, nthreads);
+  std::vector<ShardResult> shards((size_t)nt);
+
+  Py_BEGIN_ALLOW_THREADS;
+  // large batches: decode a small evenly-strided sample first and
+  // reserve every column from the scaled profile — without this the
+  // builders realloc-copy their multi-hundred-MB payloads ~log2(n)
+  // times (measured 3x wall at 10M rows)
+  ColProfile prof;
+  bool have_prof = false;
+  // the prepass is serial; with worker threads, thin the sample so its
+  // Amdahl share stays ~1/64 of ONE thread's work, not of the wall
+  const int64_t kSampleEvery = 64 * (nt > 1 ? nt : 1);
+  // = 4 * the host codec's _PER_CHUNK_ROWS (hostpath/codec.py): the
+  // per-chunk decode mode keeps chunks below this, so the prepass only
+  // engages for genuinely giant single passes
+  if (n > 262144) {
+    std::vector<Span> sample;
+    sample.reserve((size_t)(n / kSampleEvery) + 1);
+    for (int64_t i = 0; i < n; i += kSampleEvery) sample.push_back(spans[i]);
+    ShardResult sr;
+    run_shard_t(rec, coltypes, ncols, sample.data(), 0,
+                (int64_t)sample.size(), &sr);
+    if (sr.err_record == -1) {  // NOT -2: an OOM-aborted sample has a
+      profile_of(sr, &prof);    // truncated/partial profile — unusable
+      have_prof = true;
+    }
+    // a sampling error is ignored: the real pass reports it exactly
+  }
+  const ColProfile* pp = have_prof ? &prof : nullptr;
+  double total_scale = have_prof
+      ? (double)n / (double)((n + kSampleEvery - 1) / kSampleEvery) * 1.08
+      : 0.0;
+  if (nt <= 1) {
+    run_shard_t(rec, coltypes, ncols, spans.data(), 0, n, &shards[0], pp,
+                total_scale);
+  } else {
+    std::vector<std::thread> threads;
+    int64_t per = n / nt;
+    for (int t = 0; t < nt; t++) {
+      int64_t a = per * t;
+      int64_t b = (t == nt - 1) ? n : per * (t + 1);
+      ShardResult* sr = &shards[(size_t)t];
+      double sc = total_scale * ((double)(b - a) / (double)n);
+      threads.emplace_back([rec, coltypes, ncols, &spans, a, b, sr, pp,
+                            sc]() {
+        run_shard_t(rec, coltypes, ncols, spans.data(), a, b, sr, pp, sc);
+      });
+    }
+    for (auto& th : threads) th.join();
+  }
+  Py_END_ALLOW_THREADS;
+  release_spans(views, pins);
+  Py_DECREF(seq);
+
+  for (auto& s : shards) {
+    if (s.err_record == -2) {
+      PyErr_NoMemory();
+      return nullptr;
+    }
+    if (s.err_record >= 0)
+      return Py_BuildValue("(OLi)", Py_None, (long long)s.err_record,
+                           (int)s.err_bits);
+  }
+
+  // one output buffer per column (two for COL_STR), allocated at the
+  // summed size and filled per shard by build_col_buffer — COL_OFFS
+  // rebases during the copy, every other type is a straight memcpy
+  PyObject* bufs = PyList_New(0);
+  if (!bufs) return nullptr;
+  for (size_t c = 0; c < ncols; c++) {
+    int32_t ty = coltypes[c];
+    if (ty < 0 || ty > COL_OFFS) {
+      Py_DECREF(bufs);
+      PyErr_Format(PyExc_ValueError, "unknown column type %d", (int)ty);
+      return nullptr;
+    }
+    int nparts = ty == COL_STR ? 2 : 1;
+    for (int which = 0; which < nparts; which++) {
+      PyObject* b = build_col_buffer(shards, c, ty, which);
+      if (!b || PyList_Append(bufs, b) != 0) {
+        Py_XDECREF(b);
+        Py_DECREF(bufs);
+        return nullptr;
+      }
+      Py_DECREF(b);
+    }
+  }
+  PyObject* out = Py_BuildValue("(OLi)", bufs, (long long)-1, 0);
+  Py_DECREF(bufs);
+  return out;
+}
+
+}  // namespace pyr
+
+#endif  // PYRUHVRO_HOST_VM_CORE_H_

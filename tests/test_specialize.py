@@ -1,0 +1,138 @@
+"""Differential tests for the schema-specialized native decoders.
+
+The specializer (``hostpath/specialize.py``) unrolls a schema's opcode
+program into straight-line C++; these tests force specialization
+(threshold 0) and verify the generated engine against the pure-Python
+oracle and against the interpreter VM — outputs, error classes and
+error MESSAGES must be identical, since the two engines share every
+leaf helper (``host_vm_core.h``) and differ only in the walk.
+"""
+
+import pytest
+
+from pyruhvro_tpu.fallback.decoder import decode_to_record_batch
+from pyruhvro_tpu.fallback.io import MalformedAvro
+from pyruhvro_tpu.hostpath import native_available
+from pyruhvro_tpu.hostpath.codec import NativeHostCodec
+from pyruhvro_tpu.schema.cache import get_or_parse_schema
+from pyruhvro_tpu.utils.datagen import (
+    CRITERION_SHAPES,
+    KAFKA_SCHEMA_JSON,
+    WIDENED_SCHEMA_JSON,
+    kafka_style_datums,
+    random_datums,
+    widened_datums,
+)
+
+pytestmark = pytest.mark.skipif(
+    not native_available(), reason="native toolchain unavailable"
+)
+
+
+def _spec_codec(monkeypatch, schema: str) -> NativeHostCodec:
+    monkeypatch.setenv("PYRUHVRO_TPU_SPECIALIZE_ROWS", "0")
+    monkeypatch.delenv("PYRUHVRO_TPU_NO_SPECIALIZE", raising=False)
+    e = get_or_parse_schema(schema)
+    return NativeHostCodec(e.ir, e.arrow_schema)
+
+
+ALL_SHAPES = dict(CRITERION_SHAPES)
+ALL_SHAPES["kafka"] = KAFKA_SCHEMA_JSON
+ALL_SHAPES["widened"] = WIDENED_SCHEMA_JSON
+
+
+@pytest.mark.parametrize("name", sorted(ALL_SHAPES))
+def test_specialized_matches_oracle(monkeypatch, name):
+    schema = ALL_SHAPES[name]
+    e = get_or_parse_schema(schema)
+    if name == "kafka":
+        datums = kafka_style_datums(400, seed=31)
+    elif name == "widened":
+        datums = widened_datums(400)
+    else:
+        datums = random_datums(e.ir, 400, seed=31)
+    codec = _spec_codec(monkeypatch, schema)
+    got = codec.decode(datums)
+    assert codec._spec is not None, "specialization did not engage"
+    want = decode_to_record_batch(datums, e.ir, e.arrow_schema)
+    assert got.equals(want)
+    # second call reuses the compiled module
+    assert codec.decode(datums).equals(want)
+
+
+@pytest.mark.parametrize("seed", [11, 42, 101, 250, 333])
+def test_specialized_random_schema_fuzz(monkeypatch, seed):
+    from pyruhvro_tpu.gate import host_supported
+    from pyruhvro_tpu.schema.arrow_map import to_arrow_schema
+    from pyruhvro_tpu.utils.datagen import random_schema
+
+    schema_json = random_schema(seed)
+    e = get_or_parse_schema(schema_json)
+    if not host_supported(e.ir):
+        pytest.skip("outside the host subset")
+    datums = random_datums(e.ir, 200, seed=seed + 1)
+    codec = _spec_codec(monkeypatch, schema_json)
+    got = codec.decode(datums)
+    assert codec._spec is not None
+    want = decode_to_record_batch(
+        datums, e.ir, to_arrow_schema(e.ir)
+    )
+    assert got.equals(want)
+
+
+def test_specialized_truncation_matches_interpreter(monkeypatch):
+    datums = kafka_style_datums(8, seed=5)
+    spec = _spec_codec(monkeypatch, KAFKA_SCHEMA_JSON)
+    spec.decode(datums)  # engage specialization
+    assert spec._spec is not None
+    e = get_or_parse_schema(KAFKA_SCHEMA_JSON)
+    interp = NativeHostCodec(e.ir, e.arrow_schema)
+    interp._spec_failed = True  # pin the interpreter
+    whole = datums[3]
+    for cut in (0, 1, 2, len(whole) // 2, len(whole) - 1):
+        bad = list(datums)
+        bad[3] = whole[:cut]
+        msgs = []
+        for codec in (spec, interp):
+            with pytest.raises(MalformedAvro) as ei:
+                codec.decode(bad)
+            msgs.append(str(ei.value))
+        assert msgs[0] == msgs[1], f"cut={cut}: {msgs}"
+    # trailing garbage
+    bad = list(datums)
+    bad[0] = whole + b"\x00"
+    with pytest.raises(MalformedAvro, match="record 0"):
+        spec.decode(bad)
+
+
+def test_specialized_empty_and_reuse(monkeypatch):
+    codec = _spec_codec(monkeypatch, KAFKA_SCHEMA_JSON)
+    out = codec.decode([])
+    assert out.num_rows == 0
+    datums = kafka_style_datums(5, seed=9)
+    assert codec.decode(datums).num_rows == 5
+
+
+def test_threshold_accumulates_rows(monkeypatch):
+    monkeypatch.setenv("PYRUHVRO_TPU_SPECIALIZE_ROWS", "10")
+    monkeypatch.delenv("PYRUHVRO_TPU_NO_SPECIALIZE", raising=False)
+    e = get_or_parse_schema(KAFKA_SCHEMA_JSON)
+    codec = NativeHostCodec(e.ir, e.arrow_schema)
+    datums = kafka_style_datums(4, seed=13)
+    codec.decode(datums)
+    assert codec._spec is None  # 4 rows seen: under threshold
+    codec.decode(datums)
+    assert codec._spec is None  # 8 rows
+    codec.decode(datums)
+    assert codec._spec is not None  # 12 rows: crossed
+
+
+def test_no_specialize_env_pins_interpreter(monkeypatch):
+    monkeypatch.setenv("PYRUHVRO_TPU_NO_SPECIALIZE", "1")
+    monkeypatch.setenv("PYRUHVRO_TPU_SPECIALIZE_ROWS", "0")
+    e = get_or_parse_schema(KAFKA_SCHEMA_JSON)
+    codec = NativeHostCodec(e.ir, e.arrow_schema)
+    datums = kafka_style_datums(6, seed=17)
+    want = decode_to_record_batch(datums, e.ir, e.arrow_schema)
+    assert codec.decode(datums).equals(want)
+    assert codec._spec is None
