@@ -219,18 +219,24 @@ class NativeHostCodec:
         # size; past 1 GiB of bound, hint=0 selects the VM's
         # capacity-checked growth path instead of a giant eager alloc
         hint = ex.bound if ex.bound <= (1 << 30) else 0
+        self._maybe_specialize(n)
         try:
             with metrics.timer("host.encode_vm_s"):
-                try:
-                    blob, sizes = self._mod.encode(
-                        self.prog.ops, self.prog.coltypes, bufs, n, hint
+                if self._spec is not None:
+                    blob, sizes = self._spec.encode(
+                        self.prog.coltypes, bufs, n, hint
                     )
-                except TypeError:
-                    # stale pre-hint .so (build.py keeps a usable old
-                    # binary when rebuild fails): 4-arg form
-                    blob, sizes = self._mod.encode(
-                        self.prog.ops, self.prog.coltypes, bufs, n
-                    )
+                else:
+                    try:
+                        blob, sizes = self._mod.encode(
+                            self.prog.ops, self.prog.coltypes, bufs, n, hint
+                        )
+                    except TypeError:
+                        # stale pre-hint .so (build.py keeps a usable old
+                        # binary when rebuild fails): 4-arg form
+                        blob, sizes = self._mod.encode(
+                            self.prog.ops, self.prog.coltypes, bufs, n
+                        )
         except OverflowError as ex:
             if "decimal" in str(ex):
                 raise  # oracle parity (int.to_bytes overflow) — a

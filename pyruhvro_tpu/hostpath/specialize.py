@@ -44,19 +44,19 @@ from .program import (  # noqa: E402  (kept near use for readability)
 )
 
 
-class _Gen:
-    """Emit the decode body for one opcode subtree.
+class _GenBase:
+    """Shared emitter scaffolding for the two code generators.
 
-    ``present`` threads through as either the literal ``True`` (field is
-    statically reached — the dominant case, which compiles to branchless
-    straight-line reads) or the name of a C ``bool`` local minted by the
-    enclosing nullable/union.
+    ``present`` threads through ``gen`` as either the literal ``True``
+    (field is statically reached — the dominant case, which compiles to
+    branchless straight-line reads) or the name of a C ``bool`` local
+    minted by the enclosing nullable/union.
     """
 
-    def __init__(self, ops: np.ndarray):
+    def __init__(self, ops: np.ndarray, indent: int):
         self.ops = ops
         self.lines: List[str] = []
-        self.indent = 1
+        self.indent = indent
         self.uid = 0
         self.cols_used: set = set()
 
@@ -70,6 +70,13 @@ class _Gen:
     def fresh(self) -> int:
         self.uid += 1
         return self.uid
+
+
+class _Gen(_GenBase):
+    """Emit the decode body for one opcode subtree."""
+
+    def __init__(self, ops: np.ndarray):
+        super().__init__(ops, indent=1)
 
     def gen(self, pc: int, present) -> int:
         """Generate code for the subtree at ``pc``; return next pc.
@@ -213,6 +220,123 @@ class _Gen:
         raise AssertionError(f"unknown op kind {kind}")  # pragma: no cover
 
 
+class _EncGen(_GenBase):
+    """Emit the encode body for one opcode subtree — mirrors
+    ``EncVm::exec`` (host_codec.cpp) case-for-case. Entry cursors always
+    advance (absent subtrees consume their entries without emitting),
+    exactly like the VM."""
+
+    def __init__(self, ops: np.ndarray):
+        super().__init__(ops, indent=2)
+
+    def gen(self, pc: int, present) -> int:
+        kind, a, b, col, nops, _pad = (int(x) for x in self.ops[pc])
+        p = "true" if present is True else present
+
+        if kind == OP_RECORD:
+            q = pc + 1
+            stop = pc + nops
+            while q < stop:
+                q = self.gen(q, present)
+            return q
+
+        if kind in (OP_INT, OP_ENUM):
+            u = self.fresh()
+            C = self.c(col)
+            self.w(f"int32_t v{u} = {C}.i32[{C}.cur++];")
+            wr = f"write_zigzag(out, (int64_t)v{u});"
+            self.w(wr if present is True else f"if ({p}) {wr}")
+            return pc + 1
+        if kind == OP_LONG:
+            u = self.fresh()
+            C = self.c(col)
+            self.w(f"int64_t v{u} = {C}.i64[{C}.cur++];")
+            wr = f"write_zigzag(out, v{u});"
+            self.w(wr if present is True else f"if ({p}) {wr}")
+            return pc + 1
+        if kind in (OP_FLOAT, OP_DOUBLE):
+            ty, nb, fld = (("float", 4, "f32") if kind == OP_FLOAT
+                           else ("double", 8, "f64"))
+            u = self.fresh()
+            C = self.c(col)
+            self.w(f"{ty} v{u} = {C}.{fld}[{C}.cur++];")
+            wr = (f"{{ uint8_t b{u}[{nb}]; std::memcpy(b{u}, &v{u}, {nb}); "
+                  f"out.append(b{u}, {nb}); }}")
+            self.w(wr if present is True else f"if ({p}) {wr}")
+            return pc + 1
+        if kind == OP_BOOL:
+            u = self.fresh()
+            C = self.c(col)
+            self.w(f"uint8_t v{u} = {C}.u8[{C}.cur++];")
+            wr = f"out.push(v{u} ? 1 : 0);"
+            self.w(wr if present is True else f"if ({p}) {wr}")
+            return pc + 1
+        if kind == OP_STRING:
+            self.w(f"wr_string(out, {self.c(col)}, {p});")
+            return pc + 1
+        if kind == OP_FIXED:
+            C = self.c(col)
+            wr = f"out.append({C}.u8 + {C}.cur, {a});"
+            self.w(wr if present is True else f"if ({p}) {wr}")
+            self.w(f"{C}.cur += {a};")
+            return pc + 1
+        if kind in (OP_DEC_BYTES, OP_DEC_FIXED):
+            fs = -1 if kind == OP_DEC_BYTES else a
+            self.w(f"if (!wr_decimal(out, {self.c(col)}, {p}, {fs})) "
+                   f"return false;")
+            return pc + 1
+        if kind == OP_NULL:
+            return pc + 1
+
+        if kind == OP_NULLABLE:
+            u = self.fresh()
+            C = self.c(col)
+            self.w(f"uint8_t valid{u} = {C}.u8[{C}.cur++];")
+            wr = (f"write_zigzag(out, valid{u} ? (int64_t){1 - a} "
+                  f": (int64_t){a});")
+            self.w(wr if present is True else f"if ({p}) {wr}")
+            v = self.fresh()
+            sel = (f"valid{u} != 0" if present is True
+                   else f"{p} && valid{u}")
+            self.w(f"bool p{v} = {sel};")
+            return self.gen(pc + 1, f"p{v}")
+
+        if kind == OP_UNION:
+            u = self.fresh()
+            C = self.c(col)
+            self.w(f"int32_t tid{u} = {C}.i32[{C}.cur++];")
+            wr = f"write_zigzag(out, (int64_t)tid{u});"
+            self.w(wr if present is True else f"if ({p}) {wr}")
+            q = pc + 1
+            for k in range(a):
+                sel = (f"tid{u} == {k}" if present is True
+                       else f"{p} && tid{u} == {k}")
+                v = self.fresh()
+                self.w(f"bool p{v} = {sel};")
+                q = self.gen(q, f"p{v}")
+            return q
+
+        if kind in (OP_ARRAY, OP_MAP):
+            u = self.fresh()
+            C = self.c(col)
+            self.w(f"int32_t cnt{u} = {C}.i32[{C}.cur++];")
+            wr = f"if (cnt{u} > 0) write_zigzag(out, (int64_t)cnt{u});"
+            self.w(wr if present is True
+                   else f"if ({p}) {{ {wr} }}")
+            self.w(f"for (int32_t i{u} = 0; i{u} < cnt{u}; i{u}++) {{")
+            self.indent += 1
+            if kind == OP_MAP:
+                self.w(f"wr_string(out, {self.c(b)}, {p});")
+            inner_end = self.gen(pc + 1, present)
+            self.indent -= 1
+            self.w("}")
+            term = "out.push(0);  // block terminator"
+            self.w(term if present is True else f"if ({p}) {term}")
+            return inner_end
+
+        raise AssertionError(f"unknown op kind {kind}")  # pragma: no cover
+
+
 _TEMPLATE = """\
 // AUTO-GENERATED by pyruhvro_tpu.hostpath.specialize — DO NOT EDIT.
 // One schema's HostProgram unrolled into straight-line C++ over the
@@ -228,6 +352,15 @@ inline void decode_record(Reader& r, std::vector<Col>& cols) {{
 {body}
 }}
 
+struct EncRec {{
+  template <class W>
+  inline bool operator()(W& out, std::vector<InCol>& cols) const {{
+{enc_col_refs}
+{enc_body}
+    return true;
+  }}
+}};
+
 PyObject* py_decode_spec(PyObject*, PyObject* args) {{
   PyObject *coltypes_obj, *list_obj;
   int nthreads = 0;
@@ -238,9 +371,21 @@ PyObject* py_decode_spec(PyObject*, PyObject* args) {{
       coltypes_obj, list_obj, nthreads);
 }}
 
+PyObject* py_encode_spec(PyObject*, PyObject* args) {{
+  PyObject *coltypes_obj, *bufs_obj;
+  Py_ssize_t n;
+  Py_ssize_t size_hint = 0;
+  if (!PyArg_ParseTuple(args, "OOn|n", &coltypes_obj, &bufs_obj, &n,
+                        &size_hint))
+    return nullptr;
+  return encode_boundary(EncRec{{}}, coltypes_obj, bufs_obj, n, size_hint);
+}}
+
 PyMethodDef methods[] = {{
     {{"decode", py_decode_spec, METH_VARARGS,
      "decode(coltypes, data, nthreads=0) -> (buffers, err_record, err_bits)"}},
+    {{"encode", py_encode_spec, METH_VARARGS,
+     "encode(coltypes, buffers, n, size_hint=0) -> (blob, sizes)"}},
     {{nullptr, nullptr, 0, nullptr}},
 }};
 
@@ -259,17 +404,24 @@ extern "C" PyMODINIT_FUNC PyInit_{mod}(void) {{
 
 def generate_source(prog: HostProgram, mod_name: str,
                     core_include: str = "../host_vm_core.h") -> str:
-    """The C++ translation unit for one schema's decoder."""
+    """The C++ translation unit for one schema's decoder + encoder."""
     g = _Gen(prog.ops)
     g.gen(0, True)
     col_refs = "\n".join(
         f"  Col& C{c} = cols[{c}];" for c in sorted(g.cols_used)
+    )
+    eg = _EncGen(prog.ops)
+    eg.gen(0, True)
+    enc_col_refs = "\n".join(
+        f"    InCol& C{c} = cols[{c}];" for c in sorted(eg.cols_used)
     )
     return _TEMPLATE.format(
         core=core_include,
         mod=mod_name,
         col_refs=col_refs,
         body="\n".join(g.lines),
+        enc_col_refs=enc_col_refs,
+        enc_body="\n".join(eg.lines),
     )
 
 

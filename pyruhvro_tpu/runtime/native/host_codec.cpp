@@ -212,67 +212,6 @@ class Vm {
 // entries without emitting — the exact mirror of the decoder's
 // default-appending mode.
 
-struct InCol {
-  const uint8_t* u8 = nullptr;
-  const int32_t* i32 = nullptr;
-  const int64_t* i64 = nullptr;
-  const float* f32 = nullptr;
-  const double* f64 = nullptr;
-  const uint8_t* bytes = nullptr;  // COL_STR value bytes
-  size_t cur = 0;                  // entry cursor
-  size_t bcur = 0;                 // COL_STR byte cursor
-};
-
-// Output sinks for the encode VM: RawWriter assumes the caller
-// allocated the extractor's byte BOUND upfront (a strict upper bound on
-// the wire total, ops/encode.py), so every write is unchecked; VecWriter
-// is the capacity-checked fallback when no bound is available.
-struct RawWriter {
-  uint8_t* p;
-  const uint8_t* base;
-  inline void push(uint8_t b) { *p++ = b; }
-  inline void append(const void* s, size_t n) {
-    std::memcpy(p, s, n);
-    p += n;
-  }
-  inline size_t pos() const { return (size_t)(p - base); }
-};
-
-struct VecWriter {
-  std::vector<uint8_t>* v;
-  inline void push(uint8_t b) { v->push_back(b); }
-  inline void append(const void* s, size_t n) {
-    const uint8_t* s8 = static_cast<const uint8_t*>(s);
-    v->insert(v->end(), s8, s8 + n);
-  }
-  inline size_t pos() const { return v->size(); }
-};
-
-template <class W>
-inline void write_varint(W& out, uint64_t v) {
-  if (v < 0x80) {  // dominant case: branch bytes, counts, short lengths
-    out.push((uint8_t)v);
-    return;
-  }
-  while (v >= 0x80) {
-    out.push((uint8_t)(v | 0x80));
-    v >>= 7;
-  }
-  out.push((uint8_t)v);
-}
-
-template <class W>
-inline void write_zigzag(W& out, int64_t v) {
-  write_varint(out, ((uint64_t)v << 1) ^ (uint64_t)(v >> 63));
-}
-
-inline int bitlen128(unsigned __int128 a) {
-  uint64_t hi = (uint64_t)(a >> 64), lo = (uint64_t)a;
-  if (hi) return 128 - __builtin_clzll(hi);
-  if (lo) return 64 - __builtin_clzll(lo);
-  return 0;
-}
-
 template <class W>
 class EncVm {
  public:
@@ -329,7 +268,7 @@ class EncVm {
         return pc + 1;
       }
       case OP_STRING: {
-        write_string((*cols_)[op.col], present);
+        wr_string(*out_, (*cols_)[op.col], present);
         return pc + 1;
       }
       case OP_FIXED: {
@@ -342,39 +281,9 @@ class EncVm {
       }
       case OP_DEC_BYTES:
       case OP_DEC_FIXED: {
-        // 16B LE decimal128 word -> big-endian two's complement; the
-        // length rule reproduces the oracle exactly:
-        // max((abs_bit_length + 8) // 8, 1), i.e. deliberately
-        // non-minimal for negative powers of two
-        InCol& c = (*cols_)[op.col];
-        const uint8_t* p = c.u8 + c.cur;
-        c.cur += 16;
-        if (!present) return pc + 1;
-        unsigned __int128 v = 0;
-        for (int i = 15; i >= 0; i--) v = (v << 8) | p[i];
-        bool neg = (p[15] & 0x80) != 0;
-        unsigned __int128 a = neg ? (unsigned __int128)(~v + 1) : v;
-        int bits = bitlen128(a);
-        int64_t n;
-        if (op.kind == OP_DEC_BYTES) {
-          n = ((int64_t)bits + 8) / 8;
-          if (n < 1) n = 1;
-          write_zigzag(*out_, n);
-        } else {
-          n = op.a;
-          if (n < 16) {  // signed-range fit (≙ int.to_bytes overflow)
-            unsigned __int128 lim = (unsigned __int128)1 << (8 * n - 1);
-            if (neg ? (a > lim) : (a >= lim)) {
-              err = true;
-              return pc + 1;
-            }
-          }
-        }
-        for (int64_t i = 0; i < n; i++) {
-          int shift = (int)(8 * (n - 1 - i));
-          out_->push(
-              shift >= 128 ? (neg ? 0xFF : 0x00) : (uint8_t)(v >> shift));
-        }
+        if (!wr_decimal(*out_, (*cols_)[op.col], present,
+                        op.kind == OP_DEC_BYTES ? -1 : op.a))
+          err = true;
         return pc + 1;
       }
       case OP_NULL:
@@ -402,7 +311,7 @@ class EncVm {
         bool is_map = op.kind == OP_MAP;
         if (present && count > 0) write_zigzag(*out_, (int64_t)count);
         for (int32_t i = 0; i < count; i++) {
-          if (is_map) write_string((*cols_)[op.b], present);
+          if (is_map) wr_string(*out_, (*cols_)[op.b], present);
           exec(pc + 1, present);
         }
         if (present) out_->push(0);  // block terminator
@@ -413,54 +322,10 @@ class EncVm {
   }
 
  private:
-  void write_string(InCol& c, bool present) {
-    int32_t len = c.i32[c.cur++];
-    if (present) {
-      write_zigzag(*out_, (int64_t)len);
-      if (len)
-        out_->append(c.bytes + c.bcur, (size_t)len);
-    }
-    c.bcur += (size_t)len;
-  }
-
   const Op* ops_;
   std::vector<InCol>* cols_;
   W* out_;
 };
-
-// The per-record encode loop, shared by both writer strategies: runs
-// the VM once per row, records per-record sizes, stops on decimal
-// overflow (vm_err) or when the running total passes int32 offsets.
-template <class W>
-void run_encode(const Op* ops, std::vector<InCol>& cols, W& w, Py_ssize_t n,
-                int32_t* sizes, bool* overflow, bool* vm_err) {
-  EncVm<W> vm(ops, &cols, &w);
-  size_t prev = 0;
-  for (Py_ssize_t i = 0; i < n; i++) {
-    vm.exec(0, true);
-    if (vm.err) {
-      *vm_err = true;
-      return;
-    }
-    size_t pos = w.pos();
-    if (pos > (size_t)INT32_MAX) {
-      *overflow = true;
-      return;
-    }
-    sizes[i] = (int32_t)(pos - prev);
-    prev = pos;
-  }
-}
-
-int pick_threads(int64_t nrows, int requested) {
-  if (requested > 0) return requested;
-  unsigned hw = std::thread::hardware_concurrency();
-  int maxt = (int)(hw ? (hw > 16 ? 16 : hw) : 1);
-  // ~4k rows per shard minimum: merging has per-shard fixed cost
-  int by_rows = (int)(nrows / 4096);
-  int t = by_rows < maxt ? by_rows : maxt;
-  return t < 1 ? 1 : t;
-}
 
 // ---- Python boundary -------------------------------------------------
 
@@ -514,13 +379,20 @@ PyObject* py_decode(PyObject*, PyObject* args) {
 
 // encode(ops, coltypes, buffers: list, n, size_hint=0)
 //   -> (blob: bytes, sizes: bytes)
-// ``buffers`` follows the decode buffer order (COL_STR: bytes then
-// lens); ``size_hint`` (the extractor's byte bound) pre-sizes the
-// output vector so the hot loop never reallocates. Raises
-// OverflowError when the wire total exceeds int32 offsets (callers
-// split the batch). Single-threaded by design for now: row-sharding
-// encode needs per-region start cursors (cascaded prefix sums of the
-// counts columns) — worth adding on multi-core hosts.
+// The generic-interpreter entry: parses the opcode program and runs it
+// through the shared boundary (host_vm_core.h) with a VM-backed
+// per-record encoder. Schema-specialized modules provide the same
+// ``encode`` without the ops argument.
+struct VmEncRec {
+  const Op* ops;
+  template <class W>
+  bool operator()(W& w, std::vector<InCol>& cols) const {
+    EncVm<W> vm(ops, &cols, &w);
+    vm.exec(0, true);
+    return !vm.err;
+  }
+};
+
 PyObject* py_encode(PyObject*, PyObject* args) {
   PyObject *ops_obj, *coltypes_obj, *bufs_obj;
   Py_ssize_t n;
@@ -528,154 +400,14 @@ PyObject* py_encode(PyObject*, PyObject* args) {
   if (!PyArg_ParseTuple(args, "OOOn|n", &ops_obj, &coltypes_obj, &bufs_obj,
                         &n, &size_hint))
     return nullptr;
-  BufferGuard ops_b, ct_b;
-  if (!ops_b.acquire(ops_obj, "ops") || !ct_b.acquire(coltypes_obj, "coltypes"))
-    return nullptr;
-  const Op* ops = static_cast<const Op*>(ops_b.view.buf);
-  const int32_t* coltypes = static_cast<const int32_t*>(ct_b.view.buf);
-  size_t ncols = (size_t)(ct_b.view.len / sizeof(int32_t));
-
-  PyObject* seq = PySequence_Fast(bufs_obj, "buffers must be a sequence");
-  if (!seq) return nullptr;
-  // same tight-memory conditions as the sizes/VecWriter guards below:
-  // a bad_alloc must become MemoryError, never cross the extern-C
-  // boundary into std::terminate
-  std::vector<BufferGuard> guards;
-  std::vector<InCol> cols;
-  try {
-    guards.resize((size_t)PySequence_Fast_GET_SIZE(seq));
-    cols.resize(ncols);
-  } catch (const std::bad_alloc&) {
-    Py_DECREF(seq);
-    PyErr_NoMemory();
+  BufferGuard ops_b;
+  if (!ops_b.acquire(ops_obj, "ops")) return nullptr;
+  if (ops_b.view.len % sizeof(Op) != 0) {
+    PyErr_SetString(PyExc_ValueError, "ops buffer size not a multiple of op size");
     return nullptr;
   }
-  size_t bi = 0;
-  bool ok = true;
-  for (size_t c = 0; c < ncols && ok; c++) {
-    InCol& col = cols[c];
-    switch (coltypes[c]) {
-      case COL_STR: {
-        if (bi + 2 > guards.size() ||
-            !guards[bi].acquire(PySequence_Fast_GET_ITEM(seq, (Py_ssize_t)bi),
-                                "buffer") ||
-            !guards[bi + 1].acquire(
-                PySequence_Fast_GET_ITEM(seq, (Py_ssize_t)(bi + 1)),
-                "buffer")) {
-          ok = false;
-          break;
-        }
-        col.bytes = static_cast<const uint8_t*>(guards[bi].view.buf);
-        col.i32 = static_cast<const int32_t*>(guards[bi + 1].view.buf);
-        bi += 2;
-        break;
-      }
-      default: {
-        if (bi + 1 > guards.size() ||
-            !guards[bi].acquire(PySequence_Fast_GET_ITEM(seq, (Py_ssize_t)bi),
-                                "buffer")) {
-          ok = false;
-          break;
-        }
-        const void* p = guards[bi].view.buf;
-        col.u8 = static_cast<const uint8_t*>(p);
-        col.i32 = static_cast<const int32_t*>(p);
-        col.i64 = static_cast<const int64_t*>(p);
-        col.f32 = static_cast<const float*>(p);
-        col.f64 = static_cast<const double*>(p);
-        bi += 1;
-        break;
-      }
-    }
-  }
-  if (!ok || bi != guards.size()) {
-    Py_DECREF(seq);
-    if (!PyErr_Occurred())
-      PyErr_SetString(PyExc_ValueError, "buffer count mismatch with coltypes");
-    return nullptr;
-  }
-
-  std::vector<int32_t> sizes;
-  try {
-    sizes.resize((size_t)n);
-  } catch (const std::bad_alloc&) {
-    Py_DECREF(seq);
-    PyErr_NoMemory();
-    return nullptr;
-  }
-  bool overflow = false;
-  bool vm_err = false;
-
-  // Fast path: ``size_hint`` is the extractor's strict upper bound on
-  // the wire total (ops/encode.py sums per-type varint maxima + exact
-  // string bytes), so the final blob is allocated ONCE at the bound and
-  // every VM write is an unchecked raw-pointer store; the bytes object
-  // is shrunk to the real size at the end. Falls back to the
-  // capacity-checked vector writer when no bound is given or the eager
-  // allocation fails. The record loop itself is shared (run_encode).
-  PyObject* blob = nullptr;
-  if (size_hint > 0) blob = PyBytes_FromStringAndSize(nullptr, size_hint);
-  if (blob != nullptr) {
-    uint8_t* base = reinterpret_cast<uint8_t*>(PyBytes_AS_STRING(blob));
-    RawWriter w{base, base};
-    Py_BEGIN_ALLOW_THREADS;
-    run_encode(ops, cols, w, n, sizes.data(), &overflow, &vm_err);
-    Py_END_ALLOW_THREADS;
-    Py_DECREF(seq);
-    if (overflow || vm_err) {
-      Py_DECREF(blob);
-      PyErr_SetString(PyExc_OverflowError,
-                      overflow ? "encoded batch exceeds int32 binary offsets"
-                               : "decimal value does not fit its fixed size");
-      return nullptr;
-    }
-    if (_PyBytes_Resize(&blob, (Py_ssize_t)w.pos()) != 0)
-      return nullptr;  // blob already decref'd by _PyBytes_Resize
-  } else {
-    PyErr_Clear();  // bound allocation failed: geometric growth instead
-    std::vector<uint8_t> out;
-    bool oom = false;
-    Py_BEGIN_ALLOW_THREADS;
-    // this branch runs exactly when memory is already tight (the eager
-    // bound allocation above failed, or bound > int32) — a bad_alloc
-    // here must become a Python MemoryError, not std::terminate across
-    // the extern-C boundary (ADVICE r04)
-    try {
-      try {
-        out.reserve((size_t)n * 32);
-      } catch (const std::bad_alloc&) {
-        // the reserve is only a pre-size hint; geometric growth remains
-      }
-      VecWriter w{&out};
-      run_encode(ops, cols, w, n, sizes.data(), &overflow, &vm_err);
-    } catch (const std::bad_alloc&) {
-      oom = true;
-    }
-    Py_END_ALLOW_THREADS;
-    Py_DECREF(seq);
-    if (oom) {
-      PyErr_NoMemory();
-      return nullptr;
-    }
-    if (overflow || vm_err) {
-      PyErr_SetString(PyExc_OverflowError,
-                      overflow ? "encoded batch exceeds int32 binary offsets"
-                               : "decimal value does not fit its fixed size");
-      return nullptr;
-    }
-    blob = bytes_from(out.data(), out.size());
-    if (!blob) return nullptr;
-  }
-
-  PyObject* szb = bytes_from(sizes.data(), sizes.size() * 4);
-  if (!szb) {
-    Py_DECREF(blob);
-    return nullptr;
-  }
-  PyObject* res = Py_BuildValue("(OO)", blob, szb);
-  Py_DECREF(blob);
-  Py_DECREF(szb);
-  return res;
+  VmEncRec rec{static_cast<const Op*>(ops_b.view.buf)};
+  return encode_boundary(rec, coltypes_obj, bufs_obj, n, size_hint);
 }
 
 // cumsum0(lens: int32 buffer) -> bytes of int32 offsets, length n+1,

@@ -667,6 +667,302 @@ inline PyObject* decode_boundary(RecFn rec, PyObject* coltypes_obj,
   return out;
 }
 
+
+// ===================== encode (Arrow -> Avro wire) ====================
+//
+// Same sharing story as decode: the extracted-column cursors, writer
+// sinks and per-field emit leaves live here, used by BOTH the generic
+// encode VM (host_codec.cpp) and generated schema-specialized encoders.
+
+struct InCol {
+  const uint8_t* u8 = nullptr;
+  const int32_t* i32 = nullptr;
+  const int64_t* i64 = nullptr;
+  const float* f32 = nullptr;
+  const double* f64 = nullptr;
+  const uint8_t* bytes = nullptr;  // COL_STR value bytes
+  size_t cur = 0;                  // entry cursor
+  size_t bcur = 0;                 // COL_STR byte cursor
+};
+
+// Output sinks: RawWriter assumes the caller allocated the extractor's
+// byte BOUND upfront (a strict upper bound on the wire total,
+// ops/encode.py), so every write is unchecked; VecWriter is the
+// capacity-checked fallback when no bound is available.
+struct RawWriter {
+  uint8_t* p;
+  const uint8_t* base;
+  inline void push(uint8_t b) { *p++ = b; }
+  inline void append(const void* s, size_t n) {
+    std::memcpy(p, s, n);
+    p += n;
+  }
+  inline size_t pos() const { return (size_t)(p - base); }
+};
+
+struct VecWriter {
+  std::vector<uint8_t>* v;
+  inline void push(uint8_t b) { v->push_back(b); }
+  inline void append(const void* s, size_t n) {
+    const uint8_t* s8 = static_cast<const uint8_t*>(s);
+    v->insert(v->end(), s8, s8 + n);
+  }
+  inline size_t pos() const { return v->size(); }
+};
+
+template <class W>
+inline void write_varint(W& out, uint64_t v) {
+  if (v < 0x80) {  // dominant case: branch bytes, counts, short lengths
+    out.push((uint8_t)v);
+    return;
+  }
+  while (v >= 0x80) {
+    out.push((uint8_t)(v | 0x80));
+    v >>= 7;
+  }
+  out.push((uint8_t)v);
+}
+
+template <class W>
+inline void write_zigzag(W& out, int64_t v) {
+  write_varint(out, ((uint64_t)v << 1) ^ (uint64_t)(v >> 63));
+}
+
+inline int bitlen128(unsigned __int128 a) {
+  uint64_t hi = (uint64_t)(a >> 64), lo = (uint64_t)a;
+  if (hi) return 128 - __builtin_clzll(hi);
+  if (lo) return 64 - __builtin_clzll(lo);
+  return 0;
+}
+
+// ---- per-field emit leaves (shared by VM and generated code) ---------
+
+template <class W>
+inline void wr_string(W& out, InCol& c, bool present) {
+  int32_t len = c.i32[c.cur++];
+  if (present) {
+    write_zigzag(out, (int64_t)len);
+    if (len) out.append(c.bytes + c.bcur, (size_t)len);
+  }
+  c.bcur += (size_t)len;
+}
+
+// 16B LE decimal128 word -> big-endian two's complement; the length
+// rule reproduces the oracle exactly: max((abs_bit_length + 8) // 8, 1),
+// i.e. deliberately non-minimal for negative powers of two.
+// ``fixed_size < 0`` = decimal-over-bytes (length-prefixed). Returns
+// false when a fixed-size decimal does not fit its wire size
+// (≙ int.to_bytes overflow in the oracle).
+template <class W>
+inline bool wr_decimal(W& out, InCol& c, bool present, int64_t fixed_size) {
+  const uint8_t* p = c.u8 + c.cur;
+  c.cur += 16;
+  if (!present) return true;
+  unsigned __int128 v = 0;
+  for (int i = 15; i >= 0; i--) v = (v << 8) | p[i];
+  bool neg = (p[15] & 0x80) != 0;
+  unsigned __int128 a = neg ? (unsigned __int128)(~v + 1) : v;
+  int bits = bitlen128(a);
+  int64_t n;
+  if (fixed_size < 0) {
+    n = ((int64_t)bits + 8) / 8;
+    if (n < 1) n = 1;
+    write_zigzag(out, n);
+  } else {
+    n = fixed_size;
+    if (n < 16) {  // signed-range fit (≙ int.to_bytes overflow)
+      unsigned __int128 lim = (unsigned __int128)1 << (8 * n - 1);
+      if (neg ? (a > lim) : (a >= lim)) return false;
+    }
+  }
+  for (int64_t i = 0; i < n; i++) {
+    int shift = (int)(8 * (n - 1 - i));
+    out.push(shift >= 128 ? (neg ? 0xFF : 0x00) : (uint8_t)(v >> shift));
+  }
+  return true;
+}
+
+// The per-record encode loop, generic over BOTH the writer strategy and
+// the per-record encoder. ``Rec`` is a functor with
+// ``template<class W> bool operator()(W&, std::vector<InCol>&)`` that
+// encodes ONE record and returns false on a decimal range error.
+template <class Rec, class W>
+inline void run_encode_t(Rec rec, std::vector<InCol>& cols, W& w,
+                         Py_ssize_t n, int32_t* sizes, bool* overflow,
+                         bool* vm_err) {
+  size_t prev = 0;
+  for (Py_ssize_t i = 0; i < n; i++) {
+    if (!rec(w, cols)) {
+      *vm_err = true;
+      return;
+    }
+    size_t pos = w.pos();
+    if (pos > (size_t)INT32_MAX) {
+      *overflow = true;
+      return;
+    }
+    sizes[i] = (int32_t)(pos - prev);
+    prev = pos;
+  }
+}
+
+// encode boundary: (coltypes, buffers, n, size_hint) with the encoder
+// supplied by the caller -> (blob: bytes, sizes: bytes). ``buffers``
+// follows the decode buffer order (COL_STR: bytes then lens);
+// ``size_hint`` (the extractor's byte bound) pre-sizes the output so
+// the hot loop never reallocates. Raises OverflowError when the wire
+// total exceeds int32 offsets (callers split the batch).
+template <class Rec>
+inline PyObject* encode_boundary(Rec rec, PyObject* coltypes_obj,
+                                 PyObject* bufs_obj, Py_ssize_t n,
+                                 Py_ssize_t size_hint) {
+  BufferGuard ct_b;
+  if (!ct_b.acquire(coltypes_obj, "coltypes")) return nullptr;
+  const int32_t* coltypes = static_cast<const int32_t*>(ct_b.view.buf);
+  size_t ncols = (size_t)(ct_b.view.len / sizeof(int32_t));
+
+  PyObject* seq = PySequence_Fast(bufs_obj, "buffers must be a sequence");
+  if (!seq) return nullptr;
+  // a bad_alloc must become MemoryError, never cross the extern-C
+  // boundary into std::terminate (tight-memory path by definition)
+  std::vector<BufferGuard> guards;
+  std::vector<InCol> cols;
+  try {
+    guards.resize((size_t)PySequence_Fast_GET_SIZE(seq));
+    cols.resize(ncols);
+  } catch (const std::bad_alloc&) {
+    Py_DECREF(seq);
+    PyErr_NoMemory();
+    return nullptr;
+  }
+  size_t bi = 0;
+  bool ok = true;
+  for (size_t c = 0; c < ncols && ok; c++) {
+    InCol& col = cols[c];
+    switch (coltypes[c]) {
+      case COL_STR: {
+        if (bi + 2 > guards.size() ||
+            !guards[bi].acquire(PySequence_Fast_GET_ITEM(seq, (Py_ssize_t)bi),
+                                "buffer") ||
+            !guards[bi + 1].acquire(
+                PySequence_Fast_GET_ITEM(seq, (Py_ssize_t)(bi + 1)),
+                "buffer")) {
+          ok = false;
+          break;
+        }
+        col.bytes = static_cast<const uint8_t*>(guards[bi].view.buf);
+        col.i32 = static_cast<const int32_t*>(guards[bi + 1].view.buf);
+        bi += 2;
+        break;
+      }
+      default: {
+        if (bi + 1 > guards.size() ||
+            !guards[bi].acquire(PySequence_Fast_GET_ITEM(seq, (Py_ssize_t)bi),
+                                "buffer")) {
+          ok = false;
+          break;
+        }
+        const void* p = guards[bi].view.buf;
+        col.u8 = static_cast<const uint8_t*>(p);
+        col.i32 = static_cast<const int32_t*>(p);
+        col.i64 = static_cast<const int64_t*>(p);
+        col.f32 = static_cast<const float*>(p);
+        col.f64 = static_cast<const double*>(p);
+        bi += 1;
+        break;
+      }
+    }
+  }
+  if (!ok || bi != guards.size()) {
+    Py_DECREF(seq);
+    if (!PyErr_Occurred())
+      PyErr_SetString(PyExc_ValueError, "buffer count mismatch with coltypes");
+    return nullptr;
+  }
+
+  std::vector<int32_t> sizes;
+  try {
+    sizes.resize((size_t)n);
+  } catch (const std::bad_alloc&) {
+    Py_DECREF(seq);
+    PyErr_NoMemory();
+    return nullptr;
+  }
+  bool overflow = false;
+  bool vm_err = false;
+
+  // Fast path: ``size_hint`` is the extractor's strict upper bound on
+  // the wire total (ops/encode.py sums per-type varint maxima + exact
+  // string bytes), so the final blob is allocated ONCE at the bound and
+  // every write is an unchecked raw-pointer store; the bytes object is
+  // shrunk to the real size at the end. Falls back to the
+  // capacity-checked vector writer when no bound is given or the eager
+  // allocation fails.
+  PyObject* blob = nullptr;
+  if (size_hint > 0) blob = PyBytes_FromStringAndSize(nullptr, size_hint);
+  if (blob != nullptr) {
+    uint8_t* base = reinterpret_cast<uint8_t*>(PyBytes_AS_STRING(blob));
+    RawWriter w{base, base};
+    Py_BEGIN_ALLOW_THREADS;
+    run_encode_t(rec, cols, w, n, sizes.data(), &overflow, &vm_err);
+    Py_END_ALLOW_THREADS;
+    Py_DECREF(seq);
+    if (overflow || vm_err) {
+      Py_DECREF(blob);
+      PyErr_SetString(PyExc_OverflowError,
+                      overflow ? "encoded batch exceeds int32 binary offsets"
+                               : "decimal value does not fit its fixed size");
+      return nullptr;
+    }
+    if (_PyBytes_Resize(&blob, (Py_ssize_t)w.pos()) != 0)
+      return nullptr;  // blob already decref'd by _PyBytes_Resize
+  } else {
+    PyErr_Clear();  // bound allocation failed: geometric growth instead
+    std::vector<uint8_t> out;
+    bool oom = false;
+    Py_BEGIN_ALLOW_THREADS;
+    // this branch runs exactly when memory is already tight (the eager
+    // bound allocation above failed, or bound > int32) — a bad_alloc
+    // here must become a Python MemoryError, not std::terminate across
+    // the extern-C boundary (ADVICE r04)
+    try {
+      try {
+        out.reserve((size_t)n * 32);
+      } catch (const std::bad_alloc&) {
+        // the reserve is only a pre-size hint; geometric growth remains
+      }
+      VecWriter w{&out};
+      run_encode_t(rec, cols, w, n, sizes.data(), &overflow, &vm_err);
+    } catch (const std::bad_alloc&) {
+      oom = true;
+    }
+    Py_END_ALLOW_THREADS;
+    Py_DECREF(seq);
+    if (oom) {
+      PyErr_NoMemory();
+      return nullptr;
+    }
+    if (overflow || vm_err) {
+      PyErr_SetString(PyExc_OverflowError,
+                      overflow ? "encoded batch exceeds int32 binary offsets"
+                               : "decimal value does not fit its fixed size");
+      return nullptr;
+    }
+    blob = bytes_from(out.data(), out.size());
+    if (!blob) return nullptr;
+  }
+
+  PyObject* szb = bytes_from(sizes.data(), sizes.size() * 4);
+  if (!szb) {
+    Py_DECREF(blob);
+    return nullptr;
+  }
+  PyObject* res = Py_BuildValue("(OO)", blob, szb);
+  Py_DECREF(blob);
+  Py_DECREF(szb);
+  return res;
+}
+
 }  // namespace pyr
 
 #endif  // PYRUHVRO_HOST_VM_CORE_H_

@@ -58,6 +58,9 @@ def test_specialized_matches_oracle(monkeypatch, name):
     assert got.equals(want)
     # second call reuses the compiled module
     assert codec.decode(datums).equals(want)
+    # specialized ENCODE must reproduce the original wire bytes
+    arr = codec.encode(got)
+    assert [bytes(x) for x in arr] == [bytes(d) for d in datums]
 
 
 @pytest.mark.parametrize("seed", [11, 42, 101, 250, 333])
@@ -78,6 +81,8 @@ def test_specialized_random_schema_fuzz(monkeypatch, seed):
         datums, e.ir, to_arrow_schema(e.ir)
     )
     assert got.equals(want)
+    arr = codec.encode(got)
+    assert [bytes(x) for x in arr] == [bytes(d) for d in datums]
 
 
 def test_specialized_truncation_matches_interpreter(monkeypatch):
