@@ -99,15 +99,14 @@ def _combine64(lo: np.ndarray, hi: np.ndarray, view) -> np.ndarray:
 _UUID_KEEP = np.delete(np.arange(36), [8, 13, 18, 23])
 
 
-def _native_cumsum():
-    """The loaded native module IF it carries ``cumsum0`` — one shared
-    predicate, so capacity-guard sites can rely on exactly the same
-    condition ``cumsum0`` dispatches on (a stale .so without the symbol
-    must make BOTH fall back together, or the int32 guard is lost)."""
-    from ..runtime.native import build as _nb
+def _native_mod(symbol: str):
+    from ..runtime.native.build import loaded_host_codec_with
 
-    mod = _nb._modules.get("_pyruhvro_hostcodec")
-    return mod if mod is not None and hasattr(mod, "cumsum0") else None
+    return loaded_host_codec_with(symbol)
+
+
+def _native_cumsum():
+    return _native_mod("cumsum0")
 
 
 def cumsum0(lens: np.ndarray) -> np.ndarray:
@@ -284,30 +283,45 @@ class _Assembler:
         values, voff, lens = self._string_values(path, count)
         _check_utf8(values, voff, path)
 
-        out = np.zeros((count, 16), np.uint8)
         live = (
             np.ones(count, bool) if valid is None else valid.astype(bool)
         )
-        canonical = np.zeros(count, bool)
-        cand = np.flatnonzero(live & (lens == 36))
-        if cand.size:
-            if cand.size == count and values.size == count * 36:
-                # every row live and 36 chars: the value bytes are one
-                # dense (count, 36) block — zero-copy reshape instead of
-                # the fancy-index gather (the dominant cost of this
-                # column type)
-                m = values.reshape(count, 36)
-            else:
-                m = values[
-                    voff[:-1][cand, None].astype(np.int64) + np.arange(36)
-                ]
-            nib = self._HEX_LUT[m[:, _UUID_KEEP]]
-            ok = (m[:, [8, 13, 18, 23]] == ord("-")).all(axis=1) & (
-                nib != 0xFF
-            ).all(axis=1)
-            rows = cand[ok]
-            out[rows] = (nib[ok, 0::2] << 4) | nib[ok, 1::2]
-            canonical[rows] = True
+        mod = _native_mod("uuid16")
+        if mod is not None and count:
+            # native scalar parse of the canonical form (the dominant
+            # cost of this column type was the numpy LUT-gather here);
+            # converges to the shared stdlib-fallback tail below
+            out_b, okb = mod.uuid16(
+                np.ascontiguousarray(values), voff, count
+            )
+            out = np.frombuffer(bytearray(out_b), np.uint8).reshape(
+                count, 16
+            )
+            canonical = np.frombuffer(okb, np.uint8).astype(bool) & live
+            if not bool(live.all()):
+                out[~live] = 0  # dead rows emit zeros, whatever parsed
+        else:
+            out = np.zeros((count, 16), np.uint8)
+            canonical = np.zeros(count, bool)
+            cand = np.flatnonzero(live & (lens == 36))
+            if cand.size:
+                if cand.size == count and values.size == count * 36:
+                    # every row live and 36 chars: the value bytes are
+                    # one dense (count, 36) block — zero-copy reshape
+                    # instead of the fancy-index gather
+                    m = values.reshape(count, 36)
+                else:
+                    m = values[
+                        voff[:-1][cand, None].astype(np.int64)
+                        + np.arange(36)
+                    ]
+                nib = self._HEX_LUT[m[:, _UUID_KEEP]]
+                ok = (m[:, [8, 13, 18, 23]] == ord("-")).all(axis=1) & (
+                    nib != 0xFF
+                ).all(axis=1)
+                rows = cand[ok]
+                out[rows] = (nib[ok, 0::2] << 4) | nib[ok, 1::2]
+                canonical[rows] = True
         rest = np.flatnonzero(live & ~canonical)
         if rest.size:
             import uuid as _uuid_mod
@@ -383,7 +397,20 @@ class _Assembler:
             raw = np.ascontiguousarray(self.host[path + "#dec"][: count * 16])
         else:
             raw = self._decimal_raw_from_descriptors(t, path, count, valid)
-        if count:
+        mod = _native_mod("dec128_check")
+        if count and mod is not None:
+            # dead rows carry all-zero words (both layouts), so checking
+            # every row natively matches the live-masked numpy check
+            bound = 10 ** t.precision
+            bad = mod.dec128_check(
+                raw, count, bound >> 64, bound & ((1 << 64) - 1)
+            )
+            if bad >= 0:
+                raise pa.lib.ArrowInvalid(
+                    f"decimal at {path!r} row {bad} exceeds precision "
+                    f"{t.precision}"
+                )
+        elif count:
             words = raw.view(np.uint64).reshape(count, 2)
             lo, hi = words[:, 0], words[:, 1]
             neg = (hi >> np.uint64(63)) != 0

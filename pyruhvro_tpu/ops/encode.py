@@ -916,16 +916,25 @@ class _Extractor:
             raw = np.frombuffer(
                 buf, np.uint8, count=(arr.offset + n) * 16
             )[arr.offset * 16:].reshape(n, 16)
-        chars = np.empty((n, 32), np.uint8)
-        chars[:, 0::2] = self._HEXCHARS[raw >> 4]
-        chars[:, 1::2] = self._HEXCHARS[raw & 0xF]
-        out = np.empty((n, 36), np.uint8)
-        out[:, [8, 13, 18, 23]] = ord("-")
-        out[:, 0:8] = chars[:, 0:8]
-        out[:, 9:13] = chars[:, 8:12]
-        out[:, 14:18] = chars[:, 12:16]
-        out[:, 19:23] = chars[:, 16:20]
-        out[:, 24:36] = chars[:, 20:32]
+        from ..runtime.native.build import loaded_host_codec_with
+
+        mod = loaded_host_codec_with("uuid_text")
+        if mod is not None and n:
+            out = np.frombuffer(
+                mod.uuid_text(np.ascontiguousarray(raw.reshape(-1)), n),
+                np.uint8,
+            ).reshape(n, 36)
+        else:
+            chars = np.empty((n, 32), np.uint8)
+            chars[:, 0::2] = self._HEXCHARS[raw >> 4]
+            chars[:, 1::2] = self._HEXCHARS[raw & 0xF]
+            out = np.empty((n, 36), np.uint8)
+            out[:, [8, 13, 18, 23]] = ord("-")
+            out[:, 0:8] = chars[:, 0:8]
+            out[:, 9:13] = chars[:, 8:12]
+            out[:, 14:18] = chars[:, 12:16]
+            out[:, 19:23] = chars[:, 16:20]
+            out[:, 24:36] = chars[:, 20:32]
         # int32 like every #src: n*36 would wrap past ~59.6M rows, but
         # the byte bound (37n < 2^30) splits such batches before any
         # consumer sees these offsets

@@ -136,6 +136,16 @@ def _load(mod_name: str, src_file: str):
         return _modules[mod_name]
 
 
+def loaded_host_codec_with(symbol: str):
+    """The host-codec module IF it is ALREADY loaded and carries
+    ``symbol`` — the shared predicate for optional native fast paths
+    (assembler, extractor). Never triggers a JIT build, so hot paths
+    can call it freely; a stale .so without the symbol makes the guard
+    site and the dispatch site fall back together."""
+    mod = _modules.get("_pyruhvro_hostcodec")
+    return mod if mod is not None and hasattr(mod, symbol) else None
+
+
 def load_native():
     """The list[bytes] packer shim, or None if the toolchain is missing."""
     return _load("_pyruhvro_native", "packer.cpp")

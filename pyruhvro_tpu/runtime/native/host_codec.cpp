@@ -439,6 +439,170 @@ PyObject* py_cumsum0(PyObject*, PyObject* args) {
   return out;
 }
 
+
+// canonical uuid text layout: hex-char positions (dashes at 8/13/18/23)
+// — shared by the parse (uuid16) and format (uuid_text) helpers
+const int kUuidPos[32] = {0,  1,  2,  3,  4,  5,  6,  7,
+                          9,  10, 11, 12, 14, 15, 16, 17,
+                          19, 20, 21, 22, 24, 25, 26, 27,
+                          28, 29, 30, 31, 32, 33, 34, 35};
+
+// branchless hex: random nibble classes mispredict an if-chain on every
+// char — a 256-entry LUT (0xFF = non-hex) folds validity into one
+// accumulated mask checked once per row
+struct HexLut {
+  uint8_t t[256];
+  HexLut() {
+    std::memset(t, 0xFF, 256);
+    for (int k = 0; k < 10; k++) t['0' + k] = (uint8_t)k;
+    for (int k = 0; k < 6; k++) {
+      t['a' + k] = (uint8_t)(10 + k);
+      t['A' + k] = (uint8_t)(10 + k);
+    }
+  }
+};
+const HexLut kHex;
+
+// uuid16(values: u8 buffer, offsets: int32 buffer (count+1), count)
+//   -> (out: bytes 16*count, ok: bytes count)
+// Canonical 36-char uuid text (dashes at 8/13/18/23, hex elsewhere) ->
+// 16 raw bytes; anything else gets ok=0 + zero bytes and the Python
+// assembler routes it through the stdlib parser (oracle semantics).
+PyObject* py_uuid16(PyObject*, PyObject* args) {
+  PyObject *vals_obj, *offs_obj;
+  Py_ssize_t count;
+  if (!PyArg_ParseTuple(args, "OOn", &vals_obj, &offs_obj, &count))
+    return nullptr;
+  BufferGuard v_b, o_b;
+  if (!v_b.acquire(vals_obj, "values") || !o_b.acquire(offs_obj, "offsets"))
+    return nullptr;
+  if (o_b.view.len < (Py_ssize_t)((count + 1) * 4)) {
+    PyErr_SetString(PyExc_ValueError, "offsets too short");
+    return nullptr;
+  }
+  const uint8_t* vals = static_cast<const uint8_t*>(v_b.view.buf);
+  const int32_t* off = static_cast<const int32_t*>(o_b.view.buf);
+  const Py_ssize_t vals_len = v_b.view.len;
+  PyObject* out = PyBytes_FromStringAndSize(nullptr, count * 16);
+  if (!out) return nullptr;
+  PyObject* okb = PyBytes_FromStringAndSize(nullptr, count);
+  if (!okb) {
+    Py_DECREF(out);
+    return nullptr;
+  }
+  uint8_t* o = reinterpret_cast<uint8_t*>(PyBytes_AS_STRING(out));
+  uint8_t* ok = reinterpret_cast<uint8_t*>(PyBytes_AS_STRING(okb));
+  Py_BEGIN_ALLOW_THREADS;
+  for (Py_ssize_t i = 0; i < count; i++) {
+    uint8_t* dst = o + i * 16;
+    ok[i] = 0;
+    // offsets come from decode output but must not be trusted blindly:
+    // a truncated/corrupt '#bytes' buffer must fail like the numpy
+    // fancy-index (exception), never read out of bounds in C
+    if (off[i] < 0 || off[i + 1] < off[i] || off[i + 1] > vals_len ||
+        off[i + 1] - off[i] != 36) {
+      std::memset(dst, 0, 16);
+      continue;
+    }
+    const uint8_t* sp = vals + off[i];
+    if (sp[8] != '-' || sp[13] != '-' || sp[18] != '-' || sp[23] != '-') {
+      std::memset(dst, 0, 16);
+      continue;
+    }
+    uint8_t buf[16];
+    uint8_t badacc = 0;
+    for (int j = 0; j < 16; j++) {
+      uint8_t h = kHex.t[sp[kUuidPos[2 * j]]];
+      uint8_t l = kHex.t[sp[kUuidPos[2 * j + 1]]];
+      badacc |= (uint8_t)((h | l) & 0xF0);
+      buf[j] = (uint8_t)((uint8_t)(h << 4) | (l & 0xF));
+    }
+    if (badacc == 0) {
+      std::memcpy(dst, buf, 16);
+      ok[i] = 1;
+    } else {
+      std::memset(dst, 0, 16);
+    }
+  }
+  Py_END_ALLOW_THREADS;
+  PyObject* res = Py_BuildValue("(OO)", out, okb);
+  Py_DECREF(out);
+  Py_DECREF(okb);
+  return res;
+}
+
+// dec128_check(raw: u8 buffer of 16B LE decimal128 words, count,
+//              bound_hi, bound_lo) -> first row with |v| >= bound, or -1
+// (the per-row precision guard of the Arrow assembly, vectorized out of
+// Python; all-zero dead rows trivially fit)
+PyObject* py_dec128_check(PyObject*, PyObject* args) {
+  PyObject* raw_obj;
+  Py_ssize_t count;
+  unsigned long long bhi, blo;
+  if (!PyArg_ParseTuple(args, "OnKK", &raw_obj, &count, &bhi, &blo))
+    return nullptr;
+  BufferGuard r_b;
+  if (!r_b.acquire(raw_obj, "raw")) return nullptr;
+  if (r_b.view.len < (Py_ssize_t)(count * 16)) {
+    PyErr_SetString(PyExc_ValueError, "raw buffer too short");
+    return nullptr;
+  }
+  const uint8_t* raw = static_cast<const uint8_t*>(r_b.view.buf);
+  Py_ssize_t bad = -1;
+  Py_BEGIN_ALLOW_THREADS;
+  for (Py_ssize_t i = 0; i < count; i++) {
+    uint64_t lo, hi;
+    std::memcpy(&lo, raw + i * 16, 8);
+    std::memcpy(&hi, raw + i * 16 + 8, 8);
+    bool neg = (hi >> 63) != 0;
+    uint64_t lo_a = lo, hi_a = hi;
+    if (neg) {
+      lo_a = ~lo + 1;
+      hi_a = ~hi + (lo == 0 ? 1 : 0);
+    }
+    if (!(hi_a < bhi || (hi_a == bhi && lo_a < blo))) {
+      bad = i;
+      break;
+    }
+  }
+  Py_END_ALLOW_THREADS;
+  return PyLong_FromSsize_t(bad);
+}
+
+
+// uuid_text(raw: u8 buffer 16*count, count) -> bytes of 36*count chars
+// (canonical lowercase uuid text per row — the encode-side mirror of
+// uuid16; the numpy version pays two (n,16) LUT gathers + 5 strided
+// copies per batch)
+PyObject* py_uuid_text(PyObject*, PyObject* args) {
+  PyObject* raw_obj;
+  Py_ssize_t count;
+  if (!PyArg_ParseTuple(args, "On", &raw_obj, &count)) return nullptr;
+  BufferGuard r_b;
+  if (!r_b.acquire(raw_obj, "raw")) return nullptr;
+  if (r_b.view.len < (Py_ssize_t)(count * 16)) {
+    PyErr_SetString(PyExc_ValueError, "raw buffer too short");
+    return nullptr;
+  }
+  const uint8_t* raw = static_cast<const uint8_t*>(r_b.view.buf);
+  PyObject* out = PyBytes_FromStringAndSize(nullptr, count * 36);
+  if (!out) return nullptr;
+  uint8_t* o = reinterpret_cast<uint8_t*>(PyBytes_AS_STRING(out));
+  static const char HC[] = "0123456789abcdef";
+  Py_BEGIN_ALLOW_THREADS;
+  for (Py_ssize_t i = 0; i < count; i++) {
+    const uint8_t* sp = raw + i * 16;
+    uint8_t* d = o + i * 36;
+    d[8] = d[13] = d[18] = d[23] = '-';
+    for (int k = 0; k < 16; k++) {
+      d[kUuidPos[2 * k]] = (uint8_t)HC[sp[k] >> 4];
+      d[kUuidPos[2 * k + 1]] = (uint8_t)HC[sp[k] & 0xF];
+    }
+  }
+  Py_END_ALLOW_THREADS;
+  return out;
+}
+
 PyMethodDef methods[] = {
     {"decode", py_decode, METH_VARARGS,
      "decode(ops, coltypes, flat, offsets, n, nthreads=0) -> "
@@ -448,6 +612,12 @@ PyMethodDef methods[] = {
      "(blob, sizes_int32)"},
     {"cumsum0", py_cumsum0, METH_VARARGS,
      "cumsum0(lens_int32) -> int32 offsets bytes (leading 0)"},
+    {"uuid16", py_uuid16, METH_VARARGS,
+     "uuid16(values, offsets, count) -> (out16 bytes, ok bytes)"},
+    {"uuid_text", py_uuid_text, METH_VARARGS,
+     "uuid_text(raw16, count) -> 36*count chars of canonical uuid text"},
+    {"dec128_check", py_dec128_check, METH_VARARGS,
+     "dec128_check(raw16, count, bound_hi, bound_lo) -> first bad row or -1"},
     {nullptr, nullptr, 0, nullptr},
 };
 
