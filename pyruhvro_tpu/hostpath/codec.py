@@ -220,18 +220,36 @@ class NativeHostCodec:
         # capacity-checked growth path instead of a giant eager alloc
         hint = ex.bound if ex.bound <= (1 << 30) else 0
         self._maybe_specialize(n)
+        # PYRUHVRO_DEBUG_BOUNDS=1: the writer verifies every store
+        # against the extractor's bound instead of trusting it — a bound
+        # under-estimate becomes RuntimeError, not heap corruption. Read
+        # per call (it is a debug switch, toggled in tests/soaks).
+        import os
+
+        checked = 1 if os.environ.get("PYRUHVRO_DEBUG_BOUNDS") == "1" else 0
         try:
             with metrics.timer("host.encode_vm_s"):
                 if self._spec is not None:
                     blob, sizes = self._spec.encode(
-                        self.prog.coltypes, bufs, n, hint
+                        self.prog.coltypes, bufs, n, hint, checked
                     )
                 else:
                     try:
                         blob, sizes = self._mod.encode(
-                            self.prog.ops, self.prog.coltypes, bufs, n, hint
+                            self.prog.ops, self.prog.coltypes, bufs, n,
+                            hint, checked
                         )
                     except TypeError:
+                        if checked:
+                            # a stale pre-checked .so cannot honor the
+                            # bounds-verified mode — failing silently
+                            # would report a clean soak while unchecked
+                            # writes still run
+                            raise RuntimeError(
+                                "PYRUHVRO_DEBUG_BOUNDS=1 requested but "
+                                "the loaded native module predates the "
+                                "checked writer; rebuild the extension"
+                            ) from None
                         # stale pre-hint .so (build.py keeps a usable old
                         # binary when rebuild fails): 4-arg form
                         blob, sizes = self._mod.encode(

@@ -375,10 +375,12 @@ PyObject* py_encode_spec(PyObject*, PyObject* args) {{
   PyObject *coltypes_obj, *bufs_obj;
   Py_ssize_t n;
   Py_ssize_t size_hint = 0;
-  if (!PyArg_ParseTuple(args, "OOn|n", &coltypes_obj, &bufs_obj, &n,
-                        &size_hint))
+  int checked = 0;
+  if (!PyArg_ParseTuple(args, "OOn|ni", &coltypes_obj, &bufs_obj, &n,
+                        &size_hint, &checked))
     return nullptr;
-  return encode_boundary(EncRec{{}}, coltypes_obj, bufs_obj, n, size_hint);
+  return encode_boundary(EncRec{{}}, coltypes_obj, bufs_obj, n, size_hint,
+                         checked);
 }}
 
 PyMethodDef methods[] = {{

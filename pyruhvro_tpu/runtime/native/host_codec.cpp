@@ -397,8 +397,9 @@ PyObject* py_encode(PyObject*, PyObject* args) {
   PyObject *ops_obj, *coltypes_obj, *bufs_obj;
   Py_ssize_t n;
   Py_ssize_t size_hint = 0;
-  if (!PyArg_ParseTuple(args, "OOOn|n", &ops_obj, &coltypes_obj, &bufs_obj,
-                        &n, &size_hint))
+  int checked = 0;
+  if (!PyArg_ParseTuple(args, "OOOn|ni", &ops_obj, &coltypes_obj, &bufs_obj,
+                        &n, &size_hint, &checked))
     return nullptr;
   BufferGuard ops_b;
   if (!ops_b.acquire(ops_obj, "ops")) return nullptr;
@@ -407,7 +408,7 @@ PyObject* py_encode(PyObject*, PyObject* args) {
     return nullptr;
   }
   VmEncRec rec{static_cast<const Op*>(ops_b.view.buf)};
-  return encode_boundary(rec, coltypes_obj, bufs_obj, n, size_hint);
+  return encode_boundary(rec, coltypes_obj, bufs_obj, n, size_hint, checked);
 }
 
 // cumsum0(lens: int32 buffer) -> bytes of int32 offsets, length n+1,

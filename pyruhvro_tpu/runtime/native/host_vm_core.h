@@ -700,6 +700,29 @@ struct RawWriter {
   inline size_t pos() const { return (size_t)(p - base); }
 };
 
+// Debug writer (PYRUHVRO_DEBUG_BOUNDS=1): same contract as RawWriter
+// but never writes past ``end`` — overage is counted and reported as a
+// hard error at the boundary, making a bound under-estimate an
+// exception instead of heap corruption.
+struct CheckedRawWriter {
+  uint8_t* p;
+  const uint8_t* base;
+  const uint8_t* end;
+  size_t over = 0;
+  inline void push(uint8_t b) {
+    if (p < end) *p++ = b;
+    else over++;
+  }
+  inline void append(const void* s, size_t n) {
+    size_t room = (size_t)(end - p);
+    size_t w = n < room ? n : room;
+    std::memcpy(p, s, w);
+    p += w;
+    over += n - w;
+  }
+  inline size_t pos() const { return (size_t)(p - base) + over; }
+};
+
 struct VecWriter {
   std::vector<uint8_t>* v;
   inline void push(uint8_t b) { v->push_back(b); }
@@ -815,7 +838,7 @@ inline void run_encode_t(Rec rec, std::vector<InCol>& cols, W& w,
 template <class Rec>
 inline PyObject* encode_boundary(Rec rec, PyObject* coltypes_obj,
                                  PyObject* bufs_obj, Py_ssize_t n,
-                                 Py_ssize_t size_hint) {
+                                 Py_ssize_t size_hint, int checked = 0) {
   BufferGuard ct_b;
   if (!ct_b.acquire(coltypes_obj, "coltypes")) return nullptr;
   const int32_t* coltypes = static_cast<const int32_t*>(ct_b.view.buf);
@@ -902,10 +925,30 @@ inline PyObject* encode_boundary(Rec rec, PyObject* coltypes_obj,
   if (size_hint > 0) blob = PyBytes_FromStringAndSize(nullptr, size_hint);
   if (blob != nullptr) {
     uint8_t* base = reinterpret_cast<uint8_t*>(PyBytes_AS_STRING(blob));
-    RawWriter w{base, base};
-    Py_BEGIN_ALLOW_THREADS;
-    run_encode_t(rec, cols, w, n, sizes.data(), &overflow, &vm_err);
-    Py_END_ALLOW_THREADS;
+    size_t endpos;
+    if (checked) {
+      CheckedRawWriter w{base, base, base + size_hint};
+      Py_BEGIN_ALLOW_THREADS;
+      run_encode_t(rec, cols, w, n, sizes.data(), &overflow, &vm_err);
+      Py_END_ALLOW_THREADS;
+      if (w.over) {
+        Py_DECREF(seq);
+        Py_DECREF(blob);
+        PyErr_Format(
+            PyExc_RuntimeError,
+            "encode bound violated: writer overran the extractor's "
+            "%zd-byte bound by %zu bytes (PYRUHVRO_DEBUG_BOUNDS)",
+            size_hint, w.over);
+        return nullptr;
+      }
+      endpos = w.pos();
+    } else {
+      RawWriter w{base, base};
+      Py_BEGIN_ALLOW_THREADS;
+      run_encode_t(rec, cols, w, n, sizes.data(), &overflow, &vm_err);
+      Py_END_ALLOW_THREADS;
+      endpos = w.pos();
+    }
     Py_DECREF(seq);
     if (overflow || vm_err) {
       Py_DECREF(blob);
@@ -914,7 +957,7 @@ inline PyObject* encode_boundary(Rec rec, PyObject* coltypes_obj,
                                : "decimal value does not fit its fixed size");
       return nullptr;
     }
-    if (_PyBytes_Resize(&blob, (Py_ssize_t)w.pos()) != 0)
+    if (_PyBytes_Resize(&blob, (Py_ssize_t)endpos) != 0)
       return nullptr;  // blob already decref'd by _PyBytes_Resize
   } else {
     PyErr_Clear();  // bound allocation failed: geometric growth instead

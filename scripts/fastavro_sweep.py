@@ -1,0 +1,72 @@
+#!/usr/bin/env python
+"""Measured fastavro head-to-head (VERDICT r04 #6).
+
+≙ the reference's sweep (/root/reference/scripts/benchmark_sweep.py:
+{500, 5k, 50k} rows × {1, 2, 4, 8, 16} chunks, pyruhvro vs fastavro).
+fastavro is not in the bench image, so this runs where it IS installed
+(the CI job pip-installs it) and writes FASTAVRO_SWEEP.json with
+MEASURED ratios — replacing the arithmetic stand-in of earlier rounds.
+
+Run: PYTHONPATH= JAX_PLATFORMS=cpu python scripts/fastavro_sweep.py
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _best(fn, reps=3):
+    fn()
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        fn()
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def main() -> None:
+    import fastavro
+
+    from pyruhvro_tpu import deserialize_array_threaded
+    from pyruhvro_tpu.utils.datagen import KAFKA_SCHEMA_JSON, kafka_style_datums
+
+    parsed = fastavro.parse_schema(json.loads(KAFKA_SCHEMA_JSON))
+    out = {"cells": []}
+    for rows in (500, 5_000, 50_000):
+        datums = kafka_style_datums(rows, seed=7)
+
+        t_fa = _best(lambda: [
+            fastavro.schemaless_reader(io.BytesIO(d), parsed) for d in datums
+        ])
+        for chunks in (1, 2, 4, 8, 16):
+            t_us = _best(lambda: deserialize_array_threaded(
+                datums, KAFKA_SCHEMA_JSON, chunks
+            ))
+            cell = {
+                "rows": rows, "chunks": chunks,
+                "ours_rec_s": round(rows / t_us, 1),
+                "fastavro_rec_s": round(rows / t_fa, 1),
+                "speedup": round(t_fa / t_us, 2),
+            }
+            out["cells"].append(cell)
+            print(f"rows={rows} chunks={chunks}: ours {rows/t_us:,.0f} "
+                  f"vs fastavro {rows/t_fa:,.0f} rec/s "
+                  f"({t_fa/t_us:.1f}x)", file=sys.stderr)
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "FASTAVRO_SWEEP.json")
+    with open(path, "w") as f:
+        json.dump(out, f, indent=2)
+    print(json.dumps({"cells": len(out["cells"]),
+                      "min_speedup": min(c["speedup"] for c in out["cells"]),
+                      "max_speedup": max(c["speedup"] for c in out["cells"])}))
+
+
+if __name__ == "__main__":
+    main()

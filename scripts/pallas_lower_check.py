@@ -1,0 +1,64 @@
+#!/usr/bin/env python
+"""AOT-lower the Pallas walk kernel for the TPU target — no TPU needed.
+
+``jax.export`` with ``platforms=["tpu"]`` runs the full
+pallas→Mosaic-IR lowering pipeline on any host, which is exactly where
+unsupported ops/layouts surface (VERDICT r04 #9: a lowering regression
+must break CI, not a user's first run on real hardware). It does NOT
+execute the kernel — the Mosaic→machine-code stage still happens on a
+chip at XLA compile time — so this is a compilability guard, not a
+perf check (``scripts/ab_pallas.py`` covers the live chip).
+
+Run on CPU: ``PYTHONPATH= JAX_PLATFORMS=cpu python scripts/pallas_lower_check.py``
+Exit 0 = every covered shape lowers; 1 = a lowering failure (printed).
+"""
+
+from __future__ import annotations
+
+import sys
+
+sys.path.insert(0, ".")
+
+
+def main() -> int:
+    import jax
+    import numpy as np
+
+    from pyruhvro_tpu.ops import UnsupportedOnDevice
+    from pyruhvro_tpu.ops.pallas_decode import PallasKernelDecoder
+    from pyruhvro_tpu.schema.parser import parse_schema
+    from pyruhvro_tpu.utils.datagen import CRITERION_SHAPES, KAFKA_SCHEMA_JSON
+
+    shapes = dict(CRITERION_SHAPES)
+    shapes["kafka"] = KAFKA_SCHEMA_JSON
+    failures = 0
+    for name, schema in sorted(shapes.items()):
+        try:
+            dec = PallasKernelDecoder(parse_schema(schema), interpret=False)
+        except UnsupportedOnDevice as e:
+            print(f"{name:22s} SKIP (outside kernel subset): {e}")
+            continue
+        for BW in (16, 64):
+            tile_r = dec._tile_rows(BW)
+            grid_r = 1
+            fn = dec._build(grid_r, tile_r, BW)
+            R = grid_r * tile_r
+            args = (
+                np.zeros((R, BW), np.uint32),
+                np.zeros(R, np.int32),
+                np.zeros(R, np.int32),
+            )
+            try:
+                exp = jax.export.export(fn, platforms=["tpu"])(*args)
+                print(f"{name:22s} BW={BW:3d} tile_r={tile_r:4d} "
+                      f"lowered ({len(exp.mlir_module_serialized)} B mlir)")
+            except Exception as e:  # noqa: BLE001 — the guard's output
+                print(f"{name:22s} BW={BW:3d} LOWERING FAILED: "
+                      f"{type(e).__name__}: {str(e)[:300]}")
+                failures += 1
+    print(f"pallas lowering check: {failures} failures")
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

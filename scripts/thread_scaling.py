@@ -1,0 +1,56 @@
+#!/usr/bin/env python
+"""Host-VM thread scaling artifact (VERDICT r04 #5).
+
+The bench box has one vCPU, so the row-sharded VM threading
+(host_vm_core.h run_shard_t fan-out) never shows in BENCH_r*.json.
+This script measures decode throughput at nthreads ∈ {1, 2, 4} on
+whatever cores the current machine has (the 4-core CI runner is the
+intended host) and writes THREAD_SCALING.json.
+
+Run: PYTHONPATH= JAX_PLATFORMS=cpu python scripts/thread_scaling.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> None:
+    from pyruhvro_tpu.hostpath.codec import NativeHostCodec
+    from pyruhvro_tpu.schema.cache import get_or_parse_schema
+    from pyruhvro_tpu.utils.datagen import KAFKA_SCHEMA_JSON, kafka_style_datums
+
+    e = get_or_parse_schema(KAFKA_SCHEMA_JSON)
+    codec = NativeHostCodec(e.ir, e.arrow_schema)
+    out = {"cores": os.cpu_count(), "rows": {}, "engine": None}
+    for rows in (10_000, 1_000_000):
+        base = kafka_style_datums(min(rows, 50_000), seed=7)
+        datums = (base * (-(-rows // len(base))))[:rows]
+        codec.decode(datums[:1000])  # warm (+ maybe specialize)
+        cells = {}
+        for nt in (1, 2, 4):
+            best = float("inf")
+            for _ in range(3 if rows <= 10_000 else 2):
+                t0 = time.perf_counter()
+                codec.decode(datums, nthreads=nt)
+                best = min(best, time.perf_counter() - t0)
+            cells[str(nt)] = round(rows / best, 1)
+            print(f"rows={rows} nthreads={nt}: {rows / best:,.0f} rec/s",
+                  file=sys.stderr)
+        cells["speedup_4t"] = round(cells["4"] / cells["1"], 3)
+        out["rows"][str(rows)] = cells
+    out["engine"] = "specialized" if codec._spec is not None else "interpreter"
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "THREAD_SCALING.json")
+    with open(path, "w") as f:
+        json.dump(out, f, indent=2)
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()
