@@ -132,7 +132,8 @@ class DeviceCodec:
         self.ir = entry.ir
         self.arrow_schema = entry.arrow_schema
         # opt-in: run the decode walk as the Pallas kernel instead of
-        # the XLA pipeline for schemas it supports (flat, no array/map)
+        # the XLA pipeline for schemas it supports (v2: row-level
+        # array/map included; nested repetition stays on XLA)
         # — same lowered field program, explicit-kernel execution
         # (ops/pallas_decode.py). The XLA pipeline stays the default:
         # its fused single-blob transfer is tuned for high-latency
@@ -150,7 +151,7 @@ class DeviceCodec:
                     entry.ir, interpret=pallas_flag == "interpret"
                 )
             except UnsupportedOnDevice:
-                pass  # repeated fields: the XLA pipeline serves them
+                pass  # nested repetition: the XLA pipeline serves it
         if self.decoder is None:
             self.decoder = DeviceDecoder(entry.ir)
         self._encoder = None

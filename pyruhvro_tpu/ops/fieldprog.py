@@ -107,26 +107,37 @@ class Program:
 
 
 class _Ctx:
-    """Runtime (traced) values threaded through emitters."""
+    """Runtime (traced) values threaded through emitters.
 
-    __slots__ = ("words", "ends", "item_caps")
+    ``item_put`` (optional) overrides how strided item-region writes
+    lower: the default is a masked scatter (XLA handles those well in
+    HBM); the Pallas kernel supplies a 2D one-hot select strategy
+    instead, because Mosaic does not lower vector-index scatters
+    (``ops/pallas_decode.py``)."""
 
-    def __init__(self, words, ends, item_caps: Tuple[int, ...]):
+    __slots__ = ("words", "ends", "item_caps", "item_put")
+
+    def __init__(self, words, ends, item_caps: Tuple[int, ...],
+                 item_put=None):
         self.words = words
         self.ends = ends          # absolute end index per row lane
         self.item_caps = item_caps  # static cap per region (item_caps[0] unused)
+        self.item_put = item_put
 
 
-def _put(st, key, idx, val, mask):
+def _put(st, key, idx, val, mask, cx=None):
     """Masked write of one lane-vector into a column buffer.
 
     ``idx=None`` means the writes are lane-aligned (row region, one slot
     per lane) and lower to a select — XLA compiles piles of selects far
     faster than piles of scatters, and every top-level field write is one.
-    Item-region writes (strided slots) are true masked scatters."""
+    Item-region writes (strided slots) are true masked scatters, unless
+    the context supplies an ``item_put`` strategy (see :class:`_Ctx`)."""
     buf = st[key]
     if idx is None:
         st[key] = jnp.where(mask, val.astype(buf.dtype), buf)
+    elif cx is not None and cx.item_put is not None:
+        st[key] = cx.item_put(buf, idx, val.astype(buf.dtype), mask)
     else:
         safe = jnp.where(mask, idx, I32(_BIG))
         st[key] = buf.at[safe].set(val.astype(buf.dtype), mode="drop")
@@ -192,10 +203,10 @@ class _Lowering:
                 st["#cursor"] = cur
                 st = _acc_err(st, verr)
                 if wide:
-                    st = _put(st, path + "#lo", out_idx, lo, mask)
-                    st = _put(st, path + "#hi", out_idx, hi, mask)
+                    st = _put(st, path + "#lo", out_idx, lo, mask, cx)
+                    st = _put(st, path + "#hi", out_idx, hi, mask, cx)
                 else:
-                    st = _put(st, path + "#v", out_idx, lo.astype(I32), mask)
+                    st = _put(st, path + "#v", out_idx, lo.astype(I32), mask, cx)
                 return st
 
             return emit_varint
@@ -206,7 +217,7 @@ class _Lowering:
             def emit_f32(cx, st, mask, out_idx):
                 v, cur = read_f32(cx.words, st["#cursor"], mask)
                 st["#cursor"] = cur
-                return _put(st, path + "#v", out_idx, v, mask)
+                return _put(st, path + "#v", out_idx, v, mask, cx)
 
             return emit_f32
 
@@ -217,8 +228,8 @@ class _Lowering:
             def emit_f64(cx, st, mask, out_idx):
                 lo, hi, cur = _read_f64_pair(cx.words, st["#cursor"], mask)
                 st["#cursor"] = cur
-                st = _put(st, path + "#lo", out_idx, lo, mask)
-                return _put(st, path + "#hi", out_idx, hi, mask)
+                st = _put(st, path + "#lo", out_idx, lo, mask, cx)
+                return _put(st, path + "#hi", out_idx, hi, mask, cx)
 
             return emit_f64
 
@@ -229,7 +240,7 @@ class _Lowering:
                 b, cur, berr = read_bool_byte(cx.words, st["#cursor"], mask)
                 st["#cursor"] = cur
                 st = _acc_err(st, berr)
-                return _put(st, path + "#v", out_idx, b, mask)
+                return _put(st, path + "#v", out_idx, b, mask, cx)
 
             return emit_bool
 
@@ -253,8 +264,8 @@ class _Lowering:
                 slen = jnp.where(bad, 0, slen)
                 new_cur = cur + jnp.where(mask, slen, 0)
                 st = _err_where(st, mask & (new_cur > cx.ends), ERR_OVERRUN)
-                st = _put(st, path + "#start", out_idx, cur, mask)
-                st = _put(st, path + "#len", out_idx, slen, mask)
+                st = _put(st, path + "#start", out_idx, cur, mask, cx)
+                st = _put(st, path + "#len", out_idx, slen, mask, cx)
                 st["#cursor"] = new_cur
                 return st
 
@@ -274,7 +285,7 @@ class _Lowering:
             cur = st["#cursor"]
             new_cur = cur + jnp.where(mask, I32(size), 0)
             st = _err_where(st, mask & (new_cur > cx.ends), ERR_OVERRUN)
-            st = _put(st, path + "#start", out_idx, cur, mask)
+            st = _put(st, path + "#start", out_idx, cur, mask, cx)
             st["#cursor"] = new_cur
             return st
 
@@ -293,7 +304,7 @@ class _Lowering:
             st = _err_where(
                 st, mask & ((hi != 0) | (idx < 0) | (idx >= n)), ERR_BAD_ENUM
             )
-            return _put(st, path + "#v", out_idx, idx, mask)
+            return _put(st, path + "#v", out_idx, idx, mask, cx)
 
         return emit_enum
 
@@ -334,7 +345,7 @@ class _Lowering:
             absent = mask & (branch == null_idx)
             st = _err_where(st, mask & ~(present | absent), ERR_BAD_BRANCH)
             st = _put(st, path + "#valid", out_idx,
-                      jnp.full_like(branch, 1, dtype=jnp.uint8), present)
+                      jnp.full_like(branch, 1, dtype=jnp.uint8), present, cx)
             return inner(cx, st, present, out_idx)
 
         return emit_nullable
@@ -355,7 +366,7 @@ class _Lowering:
             branch, st = self._read_branch(cx, st, mask)
             st = _err_where(st, mask & ((branch < 0) | (branch >= n)),
                             ERR_BAD_BRANCH)
-            st = _put(st, path + "#tid", out_idx, branch, mask)
+            st = _put(st, path + "#tid", out_idx, branch, mask, cx)
             for k, arm in enumerate(arms):
                 if arm is not None:
                     st = arm(cx, st, mask & (branch == k), out_idx)
@@ -475,7 +486,7 @@ class _Lowering:
             st.update(sub)
             # ran out of iterations with lanes still open → malformed
             st = _err_where(st, ~done, ERR_OVERRUN)
-            return _put(st, path + "#count", out_idx, cnt, mask)
+            return _put(st, path + "#count", out_idx, cnt, mask, cx)
 
         return emit_repeated
 

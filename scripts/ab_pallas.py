@@ -1,7 +1,7 @@
 #!/usr/bin/env python
 """A/B the decode walk: XLA pipeline vs the Pallas kernel, on-device.
 
-Runs the flat-shape schemas through both device decode paths
+Runs the criterion shapes + the kafka headline schema through both device decode paths
 (``ops/decode.DeviceDecoder`` and ``ops/pallas_decode.PallasKernelDecoder``)
 on whatever backend JAX resolves, checks both against the pure-Python
 oracle, and reports wall/launch timing. On a co-located chip this
@@ -41,11 +41,17 @@ def main() -> None:
     from pyruhvro_tpu.schema.parser import parse_schema
     from pyruhvro_tpu.utils.datagen import CRITERION_SHAPES, random_datums
 
-    for shape in ("flat_primitives", "nullable_primitives", "nested_struct"):
-        schema = CRITERION_SHAPES[shape]
+    from pyruhvro_tpu.utils.datagen import KAFKA_SCHEMA_JSON, kafka_style_datums
+
+    shapes = dict(CRITERION_SHAPES)
+    shapes["kafka"] = KAFKA_SCHEMA_JSON  # v2: arrays/maps kernel-eligible
+    for shape in ("flat_primitives", "nullable_primitives", "nested_struct",
+                  "array_and_map", "kafka"):
+        schema = shapes[shape]
         ir = parse_schema(schema)
         arrow = to_arrow_schema(ir)
-        datums = random_datums(ir, args.rows, seed=11)
+        datums = (kafka_style_datums(args.rows, seed=11) if shape == "kafka"
+                  else random_datums(ir, args.rows, seed=11))
         want = decode_to_record_batch(datums, ir, arrow)
 
         # decoders are built ONCE per shape: their compiled-kernel caches

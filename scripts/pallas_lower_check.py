@@ -38,10 +38,18 @@ def main() -> int:
         except UnsupportedOnDevice as e:
             print(f"{name:22s} SKIP (outside kernel subset): {e}")
             continue
-        for BW in (16, 64):
-            tile_r = dec._tile_rows(BW)
+        has_items = dec.n_regions > 1
+        for BW, cap in [(16, 8), (64, 8)] + ([(16, 128)] if has_items
+                                             else []):
+            caps = tuple(0 if r == 0 else cap
+                         for r in range(dec.n_regions))
+            tile_r = dec._tile_rows(BW, caps)
+            if tile_r < 128:
+                print(f"{name:22s} BW={BW:3d} cap={cap} SKIP "
+                      f"(tile cannot fit VMEM — runtime falls back)")
+                continue
             grid_r = 1
-            fn = dec._build(grid_r, tile_r, BW)
+            fn = dec._build(grid_r, tile_r, BW, caps)
             R = grid_r * tile_r
             args = (
                 np.zeros((R, BW), np.uint32),
@@ -50,10 +58,12 @@ def main() -> int:
             )
             try:
                 exp = jax.export.export(fn, platforms=["tpu"])(*args)
-                print(f"{name:22s} BW={BW:3d} tile_r={tile_r:4d} "
+                print(f"{name:22s} BW={BW:3d} cap={cap:3d} "
+                      f"tile_r={tile_r:4d} "
                       f"lowered ({len(exp.mlir_module_serialized)} B mlir)")
             except Exception as e:  # noqa: BLE001 — the guard's output
-                print(f"{name:22s} BW={BW:3d} LOWERING FAILED: "
+                print(f"{name:22s} BW={BW:3d} cap={cap:3d} "
+                      f"LOWERING FAILED: "
                       f"{type(e).__name__}: {str(e)[:300]}")
                 failures += 1
     print(f"pallas lowering check: {failures} failures")
