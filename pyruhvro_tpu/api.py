@@ -139,13 +139,21 @@ def _auto_prefers_host(entry: SchemaEntry, n_rows: int) -> bool:
     """In ``backend="auto"`` with BOTH a device codec and the native host
     VM available: route to host when the device cannot win.
 
-    The decision is a one-time interconnect RTT probe
-    (:func:`.ops.codec.interconnect_rtt_s`): a co-located accelerator
-    (sub-ms RTT) beats the single-core host VM from small sizes, so the
-    device keeps the batch; a remote tunnel (tens of ms per round trip,
-    ~30 MB/s) loses to the ~2M rec/s host VM at every batch size, so
-    host serves ``auto`` and ``backend="tpu"`` remains the explicit
-    override. ``PYRUHVRO_TPU_DEVICE_MIN_ROWS=<n>`` replaces the probe."""
+    Two signals, cheapest first:
+
+    1. platform: when every JAX device is a host CPU, the XLA pipeline
+       is just a slower CPU program than the native VM (measured 60×
+       slower at the 10M-row scale) — host wins at every size. The
+       device pipeline exists for accelerators.
+    2. a one-time interconnect RTT probe
+       (:func:`.ops.codec.interconnect_rtt_s`): a co-located
+       accelerator (sub-ms RTT) beats the single-core host VM from
+       small sizes, so the device keeps the batch; a remote tunnel
+       (tens of ms per round trip, ~30 MB/s) loses to the multi-M rec/s
+       host VM at every batch size, so host serves ``auto`` and
+       ``backend="tpu"`` remains the explicit override.
+
+    ``PYRUHVRO_TPU_DEVICE_MIN_ROWS=<n>`` replaces both signals."""
     import os
 
     if _native_host_codec(entry) is None:
@@ -153,8 +161,12 @@ def _auto_prefers_host(entry: SchemaEntry, n_rows: int) -> bool:
     env = os.environ.get("PYRUHVRO_TPU_DEVICE_MIN_ROWS")
     if env:
         return n_rows < int(env)
-    from .ops.codec import interconnect_remote
+    from .ops.codec import devices_cpu_only, interconnect_remote
 
+    # safe: callers reach here only with a constructed device codec, so
+    # the memoized backend probe has already resolved (never wedges)
+    if devices_cpu_only():
+        return True
     return interconnect_remote()
 
 

@@ -80,6 +80,18 @@ def interconnect_rtt_s() -> float:
     return best
 
 
+def devices_cpu_only() -> bool:
+    """True when the RESOLVED backend probe found only host-CPU devices
+    — the routing signal ``backend="auto"`` uses to skip the device
+    pipeline entirely (an XLA walk on CPU is just a slower CPU program
+    than the native VM). Reads the memo only: callers must have built a
+    device codec first (which runs the probe), so this never wedges."""
+    devs = _probe_result[0] if _probe_result else None
+    return (devs is not None and not isinstance(devs, BaseException)
+            and len(devs) > 0
+            and all(d.platform == "cpu" for d in devs))
+
+
 def interconnect_remote(threshold_s: float = 0.010) -> bool:
     """True when the accelerator sits behind a high-latency transport
     (RTT above ``threshold_s``), where per-call round trips dominate any
