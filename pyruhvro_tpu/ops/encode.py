@@ -1019,7 +1019,13 @@ class _Extractor:
             self.put(path + "#dlen", np.zeros(0, np.int32), region)
         self.bound += 18 * n  # ≤16 value bytes + length varint
 
-    def _extract_string(self, arr, path, region) -> None:
+    @staticmethod
+    def _utf8_view(arr):
+        """(offs, values, lens) numpy views of a Utf8/Binary array's
+        buffers, offset-aware and tolerant of absent buffers (legal for
+        all-null arrays per the Arrow C data interface). ``offs`` are
+        ABSOLUTE positions into ``values``' underlying buffer (a sliced
+        array's offs[0] is nonzero); ``values`` covers [0, offs[-1])."""
         n = len(arr)
         off_buf = arr.buffers()[1]
         if off_buf is None:
@@ -1027,15 +1033,21 @@ class _Extractor:
         else:
             offs = np.frombuffer(off_buf, np.int32,
                                  count=n + arr.offset + 1)[arr.offset:]
-        base, end = int(offs[0]), int(offs[-1])
+        end = int(offs[-1])
         val_buf = arr.buffers()[2]
-        vals = (
-            np.frombuffer(val_buf, np.uint8, count=end)[base:end]
-            if val_buf is not None and end > base
+        values = (
+            np.frombuffer(val_buf, np.uint8, count=end)
+            if val_buf is not None and end
             else np.zeros(0, np.uint8)
         )
+        return offs, values, np.diff(offs).astype(np.int32)
+
+    def _extract_string(self, arr, path, region) -> None:
+        n = len(arr)
+        offs, values, lens = self._utf8_view(arr)
+        base, end = int(offs[0]), int(offs[-1])
+        vals = values[base:end]
         src = (offs[:-1] - base).astype(np.int32)
-        lens = np.diff(offs).astype(np.int32)
         self.put(path + "#src", src, region)
         self.put(path + "#len", lens, region)
         self.byte_bufs[path + "#bytes"] = vals
@@ -1043,6 +1055,72 @@ class _Extractor:
 
     def _extract_enum(self, t: Enum, arr, path, region,
                       parent: Optional[np.ndarray]) -> None:
+        n = len(arr)
+        if pa.types.is_string(arr.type) and n:
+            # vectorized symbol match on the raw utf8 buffers: per
+            # symbol, one length filter + one (cand, L) byte compare —
+            # replaces pc.index_in's generic hash kernel (~8x on the
+            # kafka enum cell). Distinct symbols can't share bytes, so
+            # each row matches at most once.
+            offs, values, lens = self._utf8_view(arr)
+            idx = np.full(n, -1, np.int32)
+            L0 = int(lens[0])
+            if bool((lens == L0).all()):
+                # uniform value width (the typical enum column): dense
+                # row-matrix compares, no candidate fancy-indexing —
+                # numpy's per-op overhead dominates at this size.
+                # Uniform lens ⇒ offsets are a ramp from offs[0], so the
+                # slice's bytes are one contiguous [n, L0] block (a
+                # sliced array's offs[0] is nonzero).
+                base = int(offs[0])
+                m = (values[base: base + n * L0].reshape(n, L0)
+                     if L0 else None)
+                for k, sym in enumerate(t.symbols):
+                    sb = np.frombuffer(sym.encode("utf-8"), np.uint8)
+                    if len(sb) != L0:
+                        continue
+                    if L0 == 0:
+                        idx[:] = k  # at most one zero-length symbol
+                    elif L0 == 1:
+                        idx[m[:, 0] == sb[0]] = k
+                    else:
+                        idx[(m == sb).all(axis=1)] = k
+            else:
+                for k, sym in enumerate(t.symbols):
+                    sb = np.frombuffer(sym.encode("utf-8"), np.uint8)
+                    L = len(sb)
+                    cand = np.flatnonzero(lens == L)
+                    if not cand.size:
+                        continue
+                    if L == 0:
+                        idx[cand] = k
+                        continue
+                    m = values[
+                        offs[:-1][cand, None].astype(np.int64)
+                        + np.arange(L)
+                    ]
+                    idx[cand[(m == sb).all(axis=1)]] = k
+            missing = idx < 0
+            valid = self._valid(arr)
+            if valid is not None:
+                missing = missing & valid
+            if parent is not None:
+                missing = missing & parent
+            if missing.any():
+                i = int(np.flatnonzero(missing)[0])
+                raise ValueError(
+                    f"value {arr[i].as_py()!r} is not a symbol of enum "
+                    f"{t.fullname}"
+                )
+            np.maximum(idx, 0, out=idx)
+            if valid is not None:
+                # null slots may own garbage bytes that happen to match
+                # a symbol; the fallback path emits 0 for them — keep
+                # the two paths byte-identical
+                idx[~valid] = 0
+            self.put(path + "#v", idx, region)
+            self.bound += 5 * n
+            return
         import pyarrow.compute as pc
 
         idx = pc.index_in(arr, value_set=pa.array(list(t.symbols), pa.utf8()))
