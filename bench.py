@@ -243,6 +243,9 @@ def main() -> None:
     os.environ["PYRUHVRO_TPU_PROBE_TIMEOUT"] = str(args.probe_timeout + 60)
 
     devices, platform, init_s = init_backend(args.probe_timeout)
+    # NOTE: when init times out, every later phase forces backend="host",
+    # which never imports ops.codec — the in-library probe watchdog
+    # cannot re-fire in the wedged branch, so no extra guard is needed
 
     from pyruhvro_tpu.utils.datagen import CRITERION_SHAPES
     from pyruhvro_tpu.utils.datagen import KAFKA_SCHEMA_JSON as kafka
