@@ -349,6 +349,10 @@ def main() -> None:
         ]:
             if backend == "host" and args.big_rows > args.host_cap:
                 continue
+            # same rep policy as every other phase: best-of-N is
+            # monotone in N, so selectively adding reps to the cell
+            # that often becomes the headline would bias it upward and
+            # break round-over-round comparability
             rec_s = _run_case("deserialize", kafka, big, backend,
                               args.chunks, max(2, args.reps - 2), details,
                               label="big/")
