@@ -575,12 +575,13 @@ inline PyObject* decode_boundary(RecFn rec, PyObject* coltypes_obj,
   }
 
   int nt = pick_threads(n, nthreads);
-  // NOTE (measured, r05): sub-sharding the serial path into ~4k-row
-  // shards does NOT help while all shard builders stay live — separate
-  // ~2.5k-row decode CALLS run ~30% faster (159 vs 225 ns/rec, kafka)
-  // because freed builders hand the next call cache-warm memory. The
-  // equivalent in-boundary win needs incremental merge-and-free, which
-  // is future work; one shard per thread keeps the boundary simple.
+  // NOTE (measured twice, r05): neither sub-sharding the serial path
+  // (~4k-row shards, all live) NOR an incremental merge-and-free
+  // sub-batch mode reproduced the ~30% gain separate small decode
+  // CALLS show (159 vs 225 ns/rec, kafka) — the in-boundary variant's
+  // growing accumulators pay realloc/page-fault churn that cancels the
+  // builder-locality win. One shard per thread stays; revisit only
+  // with a two-pass exact-size merge if this cell matters again.
   std::vector<ShardResult> shards((size_t)nt);
 
   Py_BEGIN_ALLOW_THREADS;
