@@ -29,8 +29,12 @@ Timing protocol mirrors the reference's ``python -m timeit`` best-of-N
 (``scripts/run_benchmarks.sh``): one untimed warmup (jit compile +
 caches), then best of ``--reps`` wall-clock runs. Phase counters
 (compiles, launch/transfer seconds and bytes — ``runtime/metrics.py``)
-are snapshotted per run into ``BENCH_DETAILS.json``; detailed results go
-to BENCH_DETAILS.json + stderr, never stdout.
+plus per-phase latency histograms, the routing decision and the last
+call's span tree (``runtime/telemetry.py``) are snapshotted per case
+into ``BENCH_DETAILS.json``, along with a measured spans-on vs
+spans-off overhead figure; detailed results go to BENCH_DETAILS.json +
+stderr, never stdout. Render the breakdown with
+``python -m pyruhvro_tpu.telemetry report BENCH_DETAILS.json``.
 """
 
 from __future__ import annotations
@@ -148,7 +152,7 @@ def _time_best(fn, reps: int):
 def _run_case(op, schema, datums, backend, chunks, reps, details,
               label=None):
     """Time one (op, backend) case; append a result row with metrics."""
-    from pyruhvro_tpu import metrics
+    from pyruhvro_tpu import metrics, telemetry
     from pyruhvro_tpu.api import (
         deserialize_array,
         deserialize_array_threaded,
@@ -171,7 +175,7 @@ def _run_case(op, schema, datums, backend, chunks, reps, details,
                 batch, schema, chunks, backend=backend
             )
 
-    metrics.reset()
+    telemetry.reset()  # clears spans + histograms + the flat counters
     try:
         dt = _time_best(run, reps)
     except Exception as e:
@@ -179,6 +183,7 @@ def _run_case(op, schema, datums, backend, chunks, reps, details,
         return None
     rec_s = rows / dt
     snap = metrics.snapshot()
+    tsnap = telemetry.snapshot()
     mkey = "decode" if op == "deserialize" else "encode"
     _log(f"[bench] {label or ''}{op}[{backend}] {rows} rows x{chunks}: "
          f"{dt * 1e3:.3f} ms = {rec_s:,.0f} rec/s "
@@ -187,13 +192,63 @@ def _run_case(op, schema, datums, backend, chunks, reps, details,
             f"launch={snap.get(mkey + '.launch_s', 0) * 1e3:.1f}ms "
             f"d2h={snap.get(mkey + '.d2h_bytes', 0) / 1e6:.2f}MB"
             if backend == "tpu" else ""))
+    last_span = tsnap["spans"][-1] if tsnap["spans"] else None
     details["results"].append({
         "op": op, "backend": backend, "rows": rows, "chunks": chunks,
         "schema": label or "kafka", "seconds": dt, "records_per_s": rec_s,
         "vs_baseline": rec_s / base,
         "metrics": {k: round(v, 6) for k, v in sorted(snap.items())},
+        # per-phase latency distributions + the last call's span tree
+        # (ISSUE 1: the evidence layer future perf PRs read); bucket
+        # arrays are dropped to keep BENCH_DETAILS.json reviewable
+        "telemetry": {
+            "histograms": {
+                k: {kk: vv for kk, vv in h.items() if kk != "buckets"}
+                for k, h in tsnap["histograms"].items()
+            },
+            "route": (last_span or {}).get("attrs", {}).get("route"),
+            "route_reason": (last_span or {}).get("attrs", {}).get(
+                "route_reason"),
+            "last_span": last_span,
+        },
     })
     return rec_s
+
+
+def _measure_overhead(schema, datums, chunks, reps, details):
+    """Span+histogram overhead vs bare counters on the 10k-row kafka
+    decode (ISSUE 1 acceptance: < 3%). Host tier: deterministic, no
+    device tunnel variance."""
+    from pyruhvro_tpu import telemetry
+    from pyruhvro_tpu.api import deserialize_array_threaded
+
+    def run():
+        return deserialize_array_threaded(datums, schema, chunks,
+                                          backend="host")
+
+    run()  # warmup (native build / specialization / schema cache)
+    # alternate on/off rounds and take best-of-best: the true per-call
+    # span cost (~tens of µs) is far below run-to-run drift, so a single
+    # on-then-off sequence would mostly measure machine noise
+    enabled_s = disabled_s = float("inf")
+    prev = telemetry.enabled()
+    try:
+        for _ in range(4):
+            telemetry.set_enabled(True)
+            enabled_s = min(enabled_s, _time_best(run, reps))
+            telemetry.set_enabled(False)
+            disabled_s = min(disabled_s, _time_best(run, reps))
+    finally:
+        telemetry.set_enabled(prev)
+    frac = ((enabled_s - disabled_s) / disabled_s) if disabled_s > 0 else 0.0
+    details["telemetry_overhead"] = {
+        "workload": f"deserialize kafka {len(datums)} rows x{chunks} [host]",
+        "enabled_s": round(enabled_s, 6),
+        "disabled_s": round(disabled_s, 6),
+        "overhead_frac": round(frac, 4),
+    }
+    _log(f"[bench] telemetry overhead: {frac * 100:.2f}% "
+         f"(on {enabled_s * 1e3:.3f} ms vs off {disabled_s * 1e3:.3f} ms)")
 
 
 def device_available(schema: str) -> bool:
@@ -296,6 +351,14 @@ def main() -> None:
             headline = (rec_s, name, args.rows)
         _run_case("serialize", kafka, datums, backend, args.chunks,
                   args.reps, details)
+
+    # telemetry overhead check, right after the headline workload (cheap,
+    # host-only, must not sit behind any long device-tunnel phase)
+    try:
+        _measure_overhead(kafka, datums, args.chunks,
+                          max(3, args.reps), details)
+    except Exception as e:
+        _log(f"[bench] telemetry overhead measurement failed: {e!r}")
 
     def _headline_line():
         if headline is None:

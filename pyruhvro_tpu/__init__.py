@@ -24,6 +24,10 @@ from .api import (
 )
 from .gate import device_supported, host_supported, is_supported
 from .runtime import metrics
+# bound from runtime (not the .telemetry CLI shim): `-m
+# pyruhvro_tpu.telemetry` must find its module un-imported, or runpy
+# warns about double execution; both names expose the same functions
+from .runtime import telemetry
 from .schema import parse_schema, to_arrow_schema
 
 __version__ = "0.1.0"
@@ -40,5 +44,6 @@ __all__ = [
     "parse_schema",
     "to_arrow_schema",
     "metrics",
+    "telemetry",
     "__version__",
 ]

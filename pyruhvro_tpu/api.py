@@ -40,6 +40,7 @@ from .gate import device_supported
 from .ops import UnsupportedOnDevice
 from .fallback.decoder import compile_reader, decode_to_record_batch
 from .fallback.encoder import compile_encoder_plan, encode_record_batch
+from .runtime import metrics, telemetry
 from .runtime.chunking import chunk_bounds
 from .runtime.pool import map_chunks
 from .schema.cache import SchemaEntry, get_or_parse_schema
@@ -53,23 +54,28 @@ __all__ = [
 ]
 
 
-def _device_codec(entry: SchemaEntry, backend: str):
-    """Resolve the TPU codec for this schema, or None for the host path.
+def _device_codec_ex(entry: SchemaEntry, backend: str):
+    """Resolve the TPU codec for this schema → ``(codec_or_None, reason)``.
 
-    backend="auto": device if the schema passes the fast gate AND a JAX
-    device backend initializes; silently falls back otherwise (reference
-    semantics). backend="tpu": device or raise. backend="host": None.
+    ``reason`` names why the device path was NOT taken (the routing
+    explainer recorded on the call's span). backend="auto": device if
+    the schema passes the fast gate AND a JAX device backend
+    initializes; silently falls back otherwise (reference semantics).
+    backend="tpu": device or raise. backend="host": None.
     """
     if backend == "host":
-        return None
+        return None, "backend_host"
     if backend == "auto" and entry._extras.get("device_failure") is not None:
         # device codec for THIS schema already blew up; don't re-pay the
         # failed (potentially seconds-long) init on every call. Other
-        # schemas still get the device path.
-        return None
+        # schemas still get the device path. Counted per call so a
+        # fallback storm is visible in snapshots, not just the one
+        # RuntimeWarning at first failure.
+        metrics.inc("route.device_failure")
+        return None, "device_failure_cached"
     supported = device_supported(entry.ir)
     if backend == "auto" and not supported:
-        return None
+        return None, "gate_fail"
     if not supported:  # backend == "tpu"
         raise ValueError(
             "schema is outside the device subset (e.g. decimals beyond "
@@ -85,16 +91,17 @@ def _device_codec(entry: SchemaEntry, backend: str):
             ) from e
         # missing module = deliberately host-only build, not a broken
         # backend: stay silent (reference fallback semantics)
-        return None
+        return None, "no_device_build"
     try:
-        return get_device_codec(entry)
+        return get_device_codec(entry), None
     except UnsupportedOnDevice:
         # schema outside the *device* subset (e.g. nested repetition): the
         # silent fallback here mirrors the reference's unsupported-schema
         # gate (deserialize.rs:26-29)
         if backend == "tpu":
             raise
-        return None
+        metrics.inc("route.gate_reject")
+        return None, "gate_reject"
     except Exception as e:
         # a *broken backend* is not the reference's silent-fallback case:
         # surface it once per schema, remember the failure, degrade in
@@ -105,13 +112,56 @@ def _device_codec(entry: SchemaEntry, backend: str):
             raise
         with entry._lock:
             entry._extras["device_failure"] = repr(e)
+        metrics.inc("route.device_failure")
         warnings.warn(
             f"pyruhvro_tpu device backend failed to initialize for this "
             f"schema; falling back to the (much slower) host path: {e!r}",
             RuntimeWarning,
-            stacklevel=3,  # user -> api fn -> _device_codec
+            stacklevel=4,  # user -> api fn -> _route -> _device_codec_ex
         )
-        return None
+        return None, "device_failure"
+
+
+def _device_codec(entry: SchemaEntry, backend: str):
+    """Back-compat probe (bench/tests): the codec without the reason."""
+    return _device_codec_ex(entry, backend)[0]
+
+
+def _route(entry: SchemaEntry, backend: str, n_rows: int,
+           *, need_encode: bool = False):
+    """Resolve which tier serves this call → ``(tier, impl, reason)``.
+
+    tier: ``"device"`` (impl = DeviceCodec), ``"native"`` (impl =
+    NativeHostCodec) or ``"fallback"`` (impl = None, pure-Python path).
+    ``reason`` is the routing explainer recorded on the call span — for
+    host-side tiers it names why the device path was NOT taken."""
+    codec = None
+    reason = None
+    if backend == "host":
+        reason = "backend_host"
+    elif need_encode and not _device_encode_available():
+        # decided before constructing the (decode-lowering +
+        # backend-probing) device codec, so serialize-only workloads in
+        # a host-only build never pay for it
+        if backend == "tpu":
+            raise RuntimeError(
+                "the device encode kernel is not available in this build"
+            )
+        reason = "no_device_encode"
+    else:
+        codec, reason = _device_codec_ex(entry, backend)
+        if codec is not None and backend == "auto":
+            host_reason = _auto_prefers_host(entry, n_rows)
+            if host_reason:
+                codec, reason = None, host_reason
+    if codec is not None:
+        return "device", codec, (
+            "backend_tpu" if backend == "tpu" else "device_selected"
+        )
+    native = _native_host_codec(entry)
+    if native is not None:
+        return "native", native, reason
+    return "fallback", None, reason
 
 
 def _native_host_codec(entry: SchemaEntry):
@@ -135,9 +185,14 @@ def _native_host_codec(entry: SchemaEntry):
     return entry.get_extra("native_host_codec", make)
 
 
-def _auto_prefers_host(entry: SchemaEntry, n_rows: int) -> bool:
+def _auto_prefers_host(entry: SchemaEntry, n_rows: int):
     """In ``backend="auto"`` with BOTH a device codec and the native host
     VM available: route to host when the device cannot win.
+
+    Returns the routing reason (truthy string) when host should serve,
+    else None: ``"device_min_rows"`` (env override), ``"devices_cpu_only"``
+    or ``"interconnect_remote"`` — the verdict lands on the call span and
+    in the ``route.reason.*`` counters.
 
     Two signals, cheapest first:
 
@@ -157,33 +212,36 @@ def _auto_prefers_host(entry: SchemaEntry, n_rows: int) -> bool:
     import os
 
     if _native_host_codec(entry) is None:
-        return False
+        return None
     env = os.environ.get("PYRUHVRO_TPU_DEVICE_MIN_ROWS")
     if env:
-        return n_rows < int(env)
+        return "device_min_rows" if n_rows < int(env) else None
     from .ops.codec import devices_cpu_only, interconnect_remote
 
     # safe: callers reach here only with a constructed device codec, so
     # the memoized backend probe has already resolved (never wedges)
     if devices_cpu_only():
-        return True
-    return interconnect_remote()
+        return "devices_cpu_only"
+    if interconnect_remote():
+        return "interconnect_remote"
+    return None
 
 
-_device_encode_spec = None
+# tri-state module global: None = not yet probed, else the cached bool
+_device_encode_available_memo: Optional[bool] = None
 
 
 def _device_encode_available() -> bool:
     """True when ``ops.encode`` exists (checked once, without importing
     JAX or building any codec)."""
-    global _device_encode_spec
-    if _device_encode_spec is None:
+    global _device_encode_available_memo
+    if _device_encode_available_memo is None:
         import importlib.util
 
-        _device_encode_spec = (
-            importlib.util.find_spec("pyruhvro_tpu.ops.encode") is not None,
+        _device_encode_available_memo = (
+            importlib.util.find_spec("pyruhvro_tpu.ops.encode") is not None
         )
-    return _device_encode_spec[0]
+    return _device_encode_available_memo
 
 
 def _host_reader(entry: SchemaEntry):
@@ -205,17 +263,16 @@ def deserialize_array(
     (≙ ``deserialize_array``, ``src/lib.rs:56-71``)."""
     _check_backend(backend)
     entry = get_or_parse_schema(schema)
-    codec = _device_codec(entry, backend)
-    if codec is not None and not (
-        backend == "auto" and _auto_prefers_host(entry, len(data))
-    ):
-        return codec.decode(data)
-    native = _native_host_codec(entry)
-    if native is not None:
-        return native.decode(data)
-    return decode_to_record_batch(
-        data, entry.ir, entry.arrow_schema, _host_reader(entry)
-    )
+    with telemetry.root_span("api.deserialize_array", rows=len(data),
+                             backend=backend, schema=entry.fingerprint):
+        tier, impl, reason = _route(entry, backend, len(data))
+        telemetry.set_route(tier, reason)
+        if tier != "fallback":
+            return impl.decode(data)
+        with telemetry.phase("fallback.decode_s", rows=len(data)):
+            return decode_to_record_batch(
+                data, entry.ir, entry.arrow_schema, _host_reader(entry)
+            )
 
 
 def deserialize_array_threaded(
@@ -232,19 +289,22 @@ def deserialize_array_threaded(
     _check_backend(backend)
     entry = get_or_parse_schema(schema)
     bounds = chunk_bounds(len(data), num_chunks)
-    codec = _device_codec(entry, backend)
-    if codec is not None and not (
-        backend == "auto" and _auto_prefers_host(entry, len(data))
-    ):
-        return codec.decode_threaded(data, num_chunks)
-    native = _native_host_codec(entry)
-    if native is not None:
-        return native.decode_threaded(data, num_chunks)
-    ir, arrow, reader = entry.ir, entry.arrow_schema, _host_reader(entry)
-    return map_chunks(
-        lambda ab: decode_to_record_batch(data[ab[0]:ab[1]], ir, arrow, reader),
-        bounds,
-    )
+    with telemetry.root_span("api.deserialize_array_threaded",
+                             rows=len(data), chunks=num_chunks,
+                             backend=backend, schema=entry.fingerprint):
+        tier, impl, reason = _route(entry, backend, len(data))
+        telemetry.set_route(tier, reason)
+        if tier != "fallback":
+            return impl.decode_threaded(data, num_chunks)
+        ir, arrow, reader = entry.ir, entry.arrow_schema, _host_reader(entry)
+
+        def decode_chunk(ab):
+            with telemetry.phase("fallback.decode_s", rows=ab[1] - ab[0]):
+                return decode_to_record_batch(
+                    data[ab[0]:ab[1]], ir, arrow, reader
+                )
+
+        return map_chunks(decode_chunk, bounds)
 
 
 def deserialize_array_threaded_spawn(
@@ -270,29 +330,27 @@ def serialize_record_batch(
             else pa.RecordBatch.from_pylist([], schema=batch.schema)
         )
     bounds = chunk_bounds(batch.num_rows, num_chunks)
-    # availability of the encode kernel is decided before constructing the
-    # (decode-lowering + backend-probing) device codec, so serialize-only
-    # workloads in a host-only build never pay for it
-    codec = None
-    if _device_encode_available():
-        codec = _device_codec(entry, backend)
-    elif backend == "tpu":
-        raise RuntimeError(
-            "the device encode kernel is not available in this build"
+    with telemetry.root_span("api.serialize_record_batch",
+                             rows=batch.num_rows, chunks=num_chunks,
+                             backend=backend, schema=entry.fingerprint):
+        tier, impl, reason = _route(entry, backend, batch.num_rows,
+                                    need_encode=True)
+        telemetry.set_route(tier, reason)
+        if tier != "fallback":
+            return impl.encode_threaded(batch, num_chunks)
+        ir = entry.ir
+        plan = entry.get_extra(
+            "host_encode_plan", lambda: compile_encoder_plan(ir)
         )
-    if codec is not None and not (
-        backend == "auto" and _auto_prefers_host(entry, batch.num_rows)
-    ):
-        return codec.encode_threaded(batch, num_chunks)
-    native = _native_host_codec(entry)
-    if native is not None:
-        return native.encode_threaded(batch, num_chunks)
-    ir = entry.ir
-    plan = entry.get_extra("host_encode_plan", lambda: compile_encoder_plan(ir))
-    def encode_chunk(ab):
-        datums = encode_record_batch(batch.slice(ab[0], ab[1] - ab[0]), ir, plan)
-        return pa.array(datums, pa.binary())
-    return map_chunks(encode_chunk, bounds)
+
+        def encode_chunk(ab):
+            with telemetry.phase("fallback.encode_s", rows=ab[1] - ab[0]):
+                datums = encode_record_batch(
+                    batch.slice(ab[0], ab[1] - ab[0]), ir, plan
+                )
+                return pa.array(datums, pa.binary())
+
+        return map_chunks(encode_chunk, bounds)
 
 
 def serialize_record_batch_spawn(

@@ -89,44 +89,46 @@ class NativeHostCodec:
         per-chunk mode of :meth:`decode_threaded` still reports the
         GLOBAL position of a malformed datum."""
         from ..ops.arrow_build import build_record_batch
-        from ..runtime import metrics
+        from ..runtime import telemetry
 
         n = len(data)
-        self._maybe_specialize(n)
-        # records decode straight from the caller's bytes objects (span
-        # collection in C++, ≙ extract_bytes_list src/lib.rs:29-33) —
-        # no concatenation pass exists on this path at all
-        with metrics.timer("host.vm_s"):
-            if self._spec is not None:
-                bufs, err_rec, err_bits = self._spec.decode(
-                    self.prog.coltypes, data, nthreads
+        with telemetry.phase("host.decode_s", rows=n):
+            self._maybe_specialize(n)
+            # records decode straight from the caller's bytes objects (span
+            # collection in C++, ≙ extract_bytes_list src/lib.rs:29-33) —
+            # no concatenation pass exists on this path at all
+            with telemetry.phase("host.vm_s",
+                                 specialized=self._spec is not None):
+                if self._spec is not None:
+                    bufs, err_rec, err_bits = self._spec.decode(
+                        self.prog.coltypes, data, nthreads
+                    )
+                else:
+                    bufs, err_rec, err_bits = self._mod.decode(
+                        self.prog.ops, self.prog.coltypes, data, nthreads
+                    )
+            if err_rec >= 0:
+                bit = err_bits & -err_bits
+                raise MalformedAvro(
+                    f"record {err_rec + index_base}: "
+                    f"{ERR_NAMES.get(bit, f'error bit {bit:#x}')}"
                 )
-            else:
-                bufs, err_rec, err_bits = self._mod.decode(
-                    self.prog.ops, self.prog.coltypes, data, nthreads
+            host = {}
+            for (key, dt, _region), b in zip(self._plan, bufs):
+                host[key] = np.frombuffer(b, dtype=dt)
+            item_totals = {}
+            for path in self.prog.regions[1:]:
+                k = path + "#offsets"
+                # the VM returns running totals; Arrow offsets lead with 0
+                host[k] = np.concatenate([np.zeros(1, np.int32), host[k]])
+                item_totals[path] = int(host[k][-1])
+            # string values travel in-VM (#bytes); the assembler's flat-
+            # buffer gather path is never taken on this backend
+            meta = {"item_totals": item_totals, "flat": np.zeros(0, np.uint8)}
+            with telemetry.phase("host.build_s"):
+                return build_record_batch(
+                    self.ir, self.arrow_schema, host, n, meta
                 )
-        if err_rec >= 0:
-            bit = err_bits & -err_bits
-            raise MalformedAvro(
-                f"record {err_rec + index_base}: "
-                f"{ERR_NAMES.get(bit, f'error bit {bit:#x}')}"
-            )
-        host = {}
-        for (key, dt, _region), b in zip(self._plan, bufs):
-            host[key] = np.frombuffer(b, dtype=dt)
-        item_totals = {}
-        for path in self.prog.regions[1:]:
-            k = path + "#offsets"
-            # the VM returns running totals; Arrow offsets lead with 0
-            host[k] = np.concatenate([np.zeros(1, np.int32), host[k]])
-            item_totals[path] = int(host[k][-1])
-        # string values travel in-VM (#bytes); the assembler's flat-
-        # buffer gather path is never taken on this backend
-        meta = {"item_totals": item_totals, "flat": np.zeros(0, np.uint8)}
-        with metrics.timer("host.build_s"):
-            return build_record_batch(
-                self.ir, self.arrow_schema, host, n, meta
-            )
 
     # NOTE: the C++ VM's sampled-reserve prepass activates at
     # 4 * _PER_CHUNK_ROWS rows (host_codec.cpp py_decode) — keep the
@@ -193,7 +195,7 @@ class NativeHostCodec:
         economics as ``decode_threaded``'s per-chunk mode."""
         from ..ops.decode import BatchTooLarge
         from ..ops.encode import run_extractor
-        from ..runtime import metrics
+        from ..runtime import telemetry
 
         n = batch.num_rows
         if n == 0:
@@ -210,7 +212,7 @@ class NativeHostCodec:
                 # int32 — the same capacity condition the single-pass VM
                 # reports, surfaced through the library's contract
                 raise BatchTooLarge(n, -1)
-        with metrics.timer("host.extract_s"):
+        with telemetry.phase("host.extract_s", rows=n):
             ex = run_extractor(self.ir, batch, host_mode=True)
             bufs = self._encode_buffers(ex)
         # the extractor's bound is a STRICT upper bound on the wire
@@ -228,7 +230,8 @@ class NativeHostCodec:
 
         checked = 1 if os.environ.get("PYRUHVRO_DEBUG_BOUNDS") == "1" else 0
         try:
-            with metrics.timer("host.encode_vm_s"):
+            with telemetry.phase("host.encode_vm_s",
+                                 specialized=self._spec is not None):
                 if self._spec is not None:
                     blob, sizes = self._spec.encode(
                         self.prog.coltypes, bufs, n, hint, checked

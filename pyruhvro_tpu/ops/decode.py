@@ -33,7 +33,7 @@ import numpy as np
 import time
 
 from ..fallback.io import MalformedAvro
-from ..runtime import metrics
+from ..runtime import metrics, telemetry
 from ..runtime.pack import bucket_len, concat_records
 from .fieldprog import ROWS, Program, lower
 from .varint import ERR_ITEM_OVERFLOW, ERR_NAMES
@@ -598,7 +598,7 @@ class DeviceDecoder:
         host-side assembly."""
         jax = self._jax
         n = len(data)
-        with metrics.timer("decode.pack_s"):
+        with telemetry.phase("decode.pack_s", rows=n):
             flat, offsets = concat_records(data)
         total = int(offsets[-1])
         if total > (1 << 30):
@@ -611,7 +611,7 @@ class DeviceDecoder:
         words, starts, lengths, flat = pad_views(flat, offsets, n, R, B)
         packed = pack_launch_input(words, starts, lengths, n)
 
-        with metrics.timer("decode.h2d_s"):
+        with telemetry.phase("decode.h2d_s", bytes=packed.nbytes):
             packed_d = jax.device_put(packed)
         metrics.inc("decode.h2d_bytes", packed.nbytes)
 
@@ -638,11 +638,12 @@ class DeviceDecoder:
             dt = time.perf_counter() - t0
             if fresh:  # first call pays trace+XLA-compile; track apart
                 metrics.inc("decode.compiles")
-                metrics.inc("decode.compile_launch_s", dt)
+                telemetry.observe("decode.compile_launch_s", dt,
+                                  attempt=_attempt)
             else:
                 metrics.inc("decode.launches")
-                metrics.inc("decode.launch_s", dt)
-            with metrics.timer("decode.d2h_s"):
+                telemetry.observe("decode.launch_s", dt, attempt=_attempt)
+            with telemetry.phase("decode.d2h_s"):
                 blob = np.asarray(jax.device_get(res))
             metrics.inc("decode.d2h_bytes", blob.nbytes)
             host = split_blob(blob, layout)

@@ -1353,12 +1353,12 @@ class DeviceEncoder:
         (≙ ``serialize_chunk``, ``fast_encode.rs:27-52``)."""
         import time
 
-        from ..runtime import metrics
+        from ..runtime import metrics, telemetry
 
         n = batch.num_rows
         if n == 0:
             return pa.array([], pa.binary())
-        with metrics.timer("encode.extract_s"):
+        with telemetry.phase("encode.extract_s", rows=n):
             dv, bound = extract_batch(self.prog, batch, self.ir)
         if bound >= (1 << 30):
             # int32 cursors AND the _BIG drop-sentinel both require the
@@ -1381,11 +1381,11 @@ class DeviceEncoder:
         dt = time.perf_counter() - t0
         if fresh:
             metrics.inc("encode.compiles")
-            metrics.inc("encode.compile_launch_s", dt)
+            telemetry.observe("encode.compile_launch_s", dt)
         else:
             metrics.inc("encode.launches")
-            metrics.inc("encode.launch_s", dt)
-        with metrics.timer("encode.d2h_s"):
+            telemetry.observe("encode.launch_s", dt)
+        with telemetry.phase("encode.d2h_s"):
             blob = np.asarray(jax.device_get(res))
         metrics.inc("encode.d2h_bytes", blob.nbytes)
         R = dv["#active:0"].shape[0]

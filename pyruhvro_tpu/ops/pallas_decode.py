@@ -53,7 +53,7 @@ from typing import Dict, Sequence, Tuple
 import numpy as np
 
 from ..fallback.io import MalformedAvro
-from ..runtime import metrics
+from ..runtime import metrics, telemetry
 from ..runtime.pack import bucket_len, concat_records
 from . import UnsupportedOnDevice
 from .fieldprog import ROWS, Program, _Ctx, lower
@@ -278,7 +278,7 @@ class PallasKernelDecoder:
         ``DeviceDecoder.decode_to_columns``)."""
         jax = self._jax
         n = len(data)
-        with metrics.timer("decode.pack_s"):
+        with telemetry.phase("decode.pack_s", rows=n, kernel="pallas"):
             flat, offsets = concat_records(data)
         lens_np = np.diff(offsets).astype(np.int32)
         max_b = int(lens_np.max(initial=1))
@@ -334,13 +334,13 @@ class PallasKernelDecoder:
             if R != prev_R:
                 padded, lens, act = pack(R)
                 prev_R = R
-                with metrics.timer("decode.h2d_s"):
+                with telemetry.phase("decode.h2d_s"):
                     args = (jax.device_put(padded.view(np.uint32)),
                             jax.device_put(lens), jax.device_put(act))
                 metrics.inc("decode.h2d_bytes",
                             padded.nbytes + lens.nbytes + act.nbytes)
             fn = self._fn(grid_r, tile_r, BW, caps)
-            with metrics.timer("decode.launch_s"):
+            with telemetry.phase("decode.launch_s", kernel="pallas"):
                 dev_outs = fn(*args)
             err_np = np.asarray(jax.device_get(dev_outs[err_i]))
             if not (err_np[:n] & ERR_ITEM_OVERFLOW).any():
@@ -352,7 +352,7 @@ class PallasKernelDecoder:
                 )
             caps = tuple(0 if c == 0 else c * 2 for c in caps)
         self._caps = caps
-        with metrics.timer("decode.d2h_s"):
+        with telemetry.phase("decode.d2h_s"):
             outs = [
                 err_np if i == err_i
                 else np.asarray(jax.device_get(v))

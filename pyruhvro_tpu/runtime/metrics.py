@@ -16,6 +16,7 @@ always-on: one lock + dict add per event, host-side only.
 from __future__ import annotations
 
 import threading
+import time
 from collections import defaultdict
 from typing import Dict
 
@@ -49,13 +50,9 @@ class timer:
         self.key = key
 
     def __enter__(self):
-        import time
-
         self._t0 = time.perf_counter()
         return self
 
     def __exit__(self, *exc):
-        import time
-
         inc(self.key, time.perf_counter() - self._t0)
         return False

@@ -16,6 +16,8 @@ import threading
 from concurrent.futures import ThreadPoolExecutor
 from typing import Callable, List, Sequence
 
+from . import metrics, telemetry
+
 __all__ = ["get_pool", "map_chunks"]
 
 _pool = None
@@ -36,7 +38,22 @@ def get_pool() -> ThreadPoolExecutor:
 
 def map_chunks(fn: Callable, chunks: Sequence) -> List:
     """Run ``fn`` over chunks on the pool, preserving order; a single
-    chunk runs inline (no thread hop)."""
+    chunk runs inline (no thread hop).
+
+    Each chunk runs under a ``pool.chunk_s`` span parented to the
+    CALLING thread's open span (worker threads have no span context of
+    their own), so the fan-out shows up in the call tree."""
+    metrics.inc("pool.chunks", len(chunks))
     if len(chunks) == 1:
-        return [fn(chunks[0])]
-    return list(get_pool().map(fn, chunks))
+        with telemetry.phase("pool.chunk_s", chunk=0, inline=True):
+            return [fn(chunks[0])]
+    metrics.inc("pool.fanouts")
+    parent = telemetry.current_span()
+
+    def run(i_chunk):
+        i, chunk = i_chunk
+        with telemetry.attach(parent), \
+                telemetry.phase("pool.chunk_s", chunk=i):
+            return fn(chunk)
+
+    return list(get_pool().map(run, enumerate(chunks)))

@@ -1,0 +1,596 @@
+"""Per-call span telemetry: route → pack → launch → build, observable.
+
+Layered ON TOP of the flat cumulative counters in :mod:`.metrics` (which
+stay the always-on base layer): each public API call opens a **root
+span** carrying the schema fingerprint, the backend requested, the row
+count and the routing decision with its reason; the existing phase
+boundaries (``decode.pack_s``, ``decode.h2d_s``, launch, ``decode.d2h_s``,
+``host.vm_s`` …, chunk fan-out) become **child spans** of that root, so
+one snapshot answers both "where did this call go" and "where inside it
+did the time go" — the two questions the flat counters cannot
+(ISSUE 1 / r05: ``vs_baseline`` 0.42× on ``widened`` with no record of
+why calls routed where they did).
+
+Cost model matches :func:`metrics.inc`: one lock acquisition per event
+for the telemetry layer (histogram bucket + child attach), host-side
+only, cheap enough to stay always-on. ``set_enabled(False)`` (or
+``PYRUHVRO_TPU_NO_TELEMETRY=1``) drops spans + histograms back to the
+bare counters — ``bench.py`` uses the toggle to measure the overhead.
+
+Three exporters:
+
+* :func:`snapshot` — structured dict: counters + per-``component.event``
+  fixed-bucket latency histograms (p50/p95/p99) + the most recent root
+  span trees.
+* :func:`prometheus` — the same snapshot in Prometheus text format.
+* ``PYRUHVRO_TPU_TRACE=/path/or/stderr`` — opt-in JSON-lines stream, one
+  line per finished root span.
+
+``python -m pyruhvro_tpu.telemetry report <file>`` renders a
+phase-breakdown table from a saved snapshot or a ``BENCH_DETAILS.json``
+(also reachable as ``scripts/metrics_report.py``).
+
+Naming convention (same as :mod:`.metrics`): keys are
+``component.event``; keys ending ``_s`` are seconds and get histograms,
+everything else is a plain count/byte counter.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+import time
+from bisect import bisect_left
+from collections import deque
+from typing import Any, Dict, List, Optional
+
+from . import metrics
+
+__all__ = [
+    "Span",
+    "root_span",
+    "phase",
+    "observe",
+    "current_span",
+    "attach",
+    "annotate",
+    "set_route",
+    "snapshot",
+    "prometheus",
+    "reset",
+    "set_enabled",
+    "enabled",
+    "render_report",
+    "main",
+]
+
+# fixed log-spaced latency buckets, 1 µs … 500 s (~3/decade); +Inf is
+# implicit. Fixed bounds keep observe() allocation-free and make every
+# histogram Prometheus-exportable without per-key configuration.
+_BUCKET_BOUNDS: tuple = tuple(
+    m * (10.0 ** e) for e in range(-6, 3) for m in (1.0, 2.5, 5.0)
+)
+
+_MAX_SPANS = 64  # root spans retained for snapshot(); older ones are counted
+
+_lock = threading.Lock()
+_hists: Dict[str, "_Hist"] = {}
+_spans: deque = deque(maxlen=_MAX_SPANS)
+_roots_seen = 0
+_enabled = os.environ.get("PYRUHVRO_TPU_NO_TELEMETRY") != "1"
+_tls = threading.local()
+
+
+class _Hist:
+    """Fixed-bucket latency histogram (counts per bucket + sum)."""
+
+    __slots__ = ("counts", "n", "sum")
+
+    def __init__(self):
+        self.counts = [0] * (len(_BUCKET_BOUNDS) + 1)
+        self.n = 0
+        self.sum = 0.0
+
+    def observe(self, v: float) -> None:
+        self.counts[bisect_left(_BUCKET_BOUNDS, v)] += 1
+        self.n += 1
+        self.sum += v
+
+    def quantile(self, q: float) -> float:
+        """Upper bucket bound holding the q-quantile (Prometheus-style)."""
+        if not self.n:
+            return 0.0
+        target = q * self.n
+        cum = 0
+        for i, c in enumerate(self.counts):
+            cum += c
+            if c and cum >= target:
+                return (_BUCKET_BOUNDS[i] if i < len(_BUCKET_BOUNDS)
+                        else float("inf"))
+        return float("inf")
+
+    def summary(self) -> Dict[str, Any]:
+        buckets: List[list] = []
+        cum = 0
+        for i, c in enumerate(self.counts):
+            cum += c
+            if c:
+                le = (_BUCKET_BOUNDS[i] if i < len(_BUCKET_BOUNDS)
+                      else "+Inf")
+                buckets.append([le, cum])
+        if not buckets or buckets[-1][0] != "+Inf":
+            buckets.append(["+Inf", cum])
+        return {
+            "count": self.n,
+            "sum": self.sum,
+            "p50": self.quantile(0.50),
+            "p95": self.quantile(0.95),
+            "p99": self.quantile(0.99),
+            "buckets": buckets,  # cumulative [le, n], zero buckets elided
+        }
+
+
+def _hist(key: str) -> _Hist:
+    """Get-or-create; callers hold ``_lock``."""
+    h = _hists.get(key)
+    if h is None:
+        h = _hists[key] = _Hist()
+    return h
+
+
+# ---------------------------------------------------------------------------
+# spans
+# ---------------------------------------------------------------------------
+
+
+class Span:
+    """One timed node of a call tree (root = public API call)."""
+
+    __slots__ = ("name", "attrs", "children", "dur_s", "ts", "_t0")
+
+    def __init__(self, name: str, attrs: Dict[str, Any]):
+        self.name = name
+        self.attrs = attrs
+        self.children: List[Span] = []
+        self.dur_s: Optional[float] = None
+        self.ts = time.time()
+        self._t0 = time.perf_counter()
+
+    def to_dict(self) -> Dict[str, Any]:
+        d: Dict[str, Any] = {
+            "name": self.name,
+            "ts": round(self.ts, 6),
+            "dur_s": self.dur_s,
+            "attrs": dict(self.attrs),
+        }
+        if self.children:
+            d["children"] = [c.to_dict() for c in self.children]
+        return d
+
+
+def current_span() -> Optional[Span]:
+    """The innermost open span on THIS thread (None outside API calls)."""
+    return getattr(_tls, "span", None)
+
+
+class attach:
+    """Adopt ``span`` as the current span on this thread.
+
+    The pool workers use it so chunk child spans parent under the
+    CALLING thread's root span instead of getting lost (the worker
+    thread has no span context of its own)."""
+
+    __slots__ = ("span", "_prev")
+
+    def __init__(self, span: Optional[Span]):
+        self.span = span
+
+    def __enter__(self):
+        self._prev = getattr(_tls, "span", None)
+        _tls.span = self.span
+        return self.span
+
+    def __exit__(self, *exc):
+        _tls.span = self._prev
+        return False
+
+
+class root_span:
+    """Open the per-call root span (one per public API entry).
+
+    Disabled mode is a no-op (the flat counters the call sites feed via
+    :class:`phase`/:func:`observe` still flow). A root opened while
+    another is active on the thread (nested API use) attaches as a child
+    of the outer one and is not separately retained."""
+
+    __slots__ = ("span", "_prev")
+
+    def __init__(self, name: str, **attrs):
+        self.span = Span(name, attrs) if _enabled else None
+
+    def __enter__(self):
+        s = self.span
+        if s is None:
+            return None
+        self._prev = getattr(_tls, "span", None)
+        if self._prev is not None:
+            with _lock:
+                self._prev.children.append(s)
+        _tls.span = s
+        return s
+
+    def __exit__(self, exc_type, exc, tb):
+        s = self.span
+        if s is None:
+            return False
+        s.dur_s = round(time.perf_counter() - s._t0, 9)
+        if exc_type is not None:
+            s.attrs["error"] = exc_type.__name__
+        _tls.span = self._prev
+        metrics.inc(s.name + "_s", s.dur_s)
+        global _roots_seen
+        with _lock:
+            _hist(s.name + "_s").observe(s.dur_s)
+            if self._prev is None:
+                _spans.append(s)
+                _roots_seen += 1
+        if self._prev is None:
+            _maybe_trace(s)
+        return False
+
+
+class phase:
+    """``with phase("decode.pack_s"): ...`` — the span-aware timer.
+
+    Always adds elapsed seconds to the flat counter (drop-in for
+    ``metrics.timer``); when telemetry is enabled it additionally
+    observes the latency histogram and, under an open root span, attaches
+    a child span (nesting: phases inside phases build a real tree)."""
+
+    __slots__ = ("key", "attrs", "span", "_t0", "_prev")
+
+    def __init__(self, key: str, **attrs):
+        self.key = key
+        self.attrs = attrs
+        self.span = None
+
+    def __enter__(self):
+        if _enabled:
+            parent = getattr(_tls, "span", None)
+            if parent is not None:
+                self.span = Span(self.key, self.attrs)
+                with _lock:
+                    parent.children.append(self.span)
+                self._prev = parent
+                _tls.span = self.span
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        dt = time.perf_counter() - self._t0
+        metrics.inc(self.key, dt)
+        if self.span is not None:
+            self.span.dur_s = round(dt, 9)
+            if exc_type is not None:
+                self.span.attrs["error"] = exc_type.__name__
+            _tls.span = self._prev
+        if _enabled:
+            with _lock:
+                _hist(self.key).observe(dt)
+        return False
+
+
+def observe(key: str, seconds: float, **attrs) -> None:
+    """Record a pre-measured duration: counter + histogram + child span.
+
+    For call sites that time manually (e.g. the async-dispatch launch
+    split in ``ops/decode.py`` where compile vs launch is decided after
+    the fact)."""
+    metrics.inc(key, seconds)
+    if not _enabled:
+        return
+    parent = getattr(_tls, "span", None)
+    with _lock:
+        _hist(key).observe(seconds)
+        if parent is not None:
+            s = Span(key, attrs)
+            # the interval ENDED at creation: shift ts back so the span's
+            # [ts, ts+dur_s] window is the real one in trace timelines
+            s.ts -= seconds
+            s.dur_s = round(seconds, 9)
+            parent.children.append(s)
+
+
+def annotate(**attrs) -> None:
+    """Merge attributes into the current span (no-op outside a span)."""
+    s = getattr(_tls, "span", None)
+    if s is not None:
+        s.attrs.update(attrs)
+
+
+def set_route(tier: str, reason: Optional[str] = None) -> None:
+    """Record where THIS call was routed (device/native/fallback) and
+    why — on the root span AND as flat ``route.*`` counters, so fallback
+    storms show in snapshots even with spans disabled."""
+    metrics.inc("route." + tier)
+    if reason:
+        metrics.inc("route.reason." + reason)
+    s = getattr(_tls, "span", None)
+    if s is not None:
+        s.attrs["route"] = tier
+        if reason:
+            s.attrs["route_reason"] = reason
+
+
+def set_enabled(flag: bool) -> None:
+    """Toggle spans + histograms (flat counters always stay on)."""
+    global _enabled
+    _enabled = bool(flag)
+
+
+def enabled() -> bool:
+    return _enabled
+
+
+def reset() -> None:
+    """Clear spans, histograms AND the flat counters (test isolation);
+    also closes any open trace sink so redirected streams don't leak."""
+    global _roots_seen, _trace_memo
+    with _lock:
+        _hists.clear()
+        _spans.clear()
+        _roots_seen = 0
+    with _trace_lock:
+        if _trace_memo is not None:
+            fh = _trace_memo[1]
+            if fh is not None and fh is not sys.stderr:
+                try:
+                    fh.close()
+                except OSError:
+                    pass
+            _trace_memo = None
+    metrics.reset()
+
+
+# ---------------------------------------------------------------------------
+# exporters
+# ---------------------------------------------------------------------------
+
+
+def snapshot() -> Dict[str, Any]:
+    """Structured export: flat counters + histogram summaries + the most
+    recent root span trees (oldest→newest; ``spans_dropped`` counts roots
+    aged out of the ring)."""
+    with _lock:
+        hists = {k: h.summary() for k, h in sorted(_hists.items())}
+        spans = [s.to_dict() for s in _spans]
+        dropped = _roots_seen - len(_spans)
+    return {
+        "counters": metrics.snapshot(),
+        "histograms": hists,
+        "spans": spans,
+        "spans_dropped": dropped,
+    }
+
+
+def _prom_name(key: str) -> str:
+    base = key[:-2] + "_seconds" if key.endswith("_s") else key
+    name = "".join(ch if ch.isalnum() or ch == "_" else "_" for ch in base)
+    if name and name[0].isdigit():
+        name = "_" + name
+    return "pyruhvro_tpu_" + name
+
+
+def prometheus(snap: Optional[Dict[str, Any]] = None) -> str:
+    """Prometheus text exposition of a snapshot (default: live state).
+
+    Counters export as ``*_total`` counters (keys ending ``_s`` as
+    ``*_seconds_total``); histograms as ``_bucket``/``_sum``/``_count``
+    families with the fixed bucket bounds."""
+    if snap is None:
+        snap = snapshot()
+    lines: List[str] = []
+    for key, v in sorted(snap.get("counters", {}).items()):
+        name = _prom_name(key) + "_total"
+        lines.append(f"# TYPE {name} counter")
+        lines.append(f"{name} {float(v)!r}")
+    for key, h in sorted(snap.get("histograms", {}).items()):
+        name = _prom_name(key)
+        lines.append(f"# TYPE {name} histogram")
+        seen_inf = False
+        for le, cum in h.get("buckets", []):
+            if le == "+Inf":
+                seen_inf = True
+                lines.append(f'{name}_bucket{{le="+Inf"}} {cum}')
+            else:
+                lines.append(f'{name}_bucket{{le="{float(le)!r}"}} {cum}')
+        if not seen_inf:
+            lines.append(f'{name}_bucket{{le="+Inf"}} {h["count"]}')
+        lines.append(f"{name}_sum {float(h['sum'])!r}")
+        lines.append(f"{name}_count {h['count']}")
+    return "\n".join(lines) + ("\n" if lines else "")
+
+
+# -- JSON-lines trace stream (opt-in) ---------------------------------------
+
+_trace_lock = threading.Lock()
+_trace_memo: Optional[tuple] = None  # (path, file handle | None)
+
+
+def _trace_sink():
+    """Resolve PYRUHVRO_TPU_TRACE to a writable handle (memoized per
+    path; re-resolved when the env var changes, so tests can redirect)."""
+    global _trace_memo
+    path = os.environ.get("PYRUHVRO_TPU_TRACE", "")
+    if not path:
+        return None
+    memo = _trace_memo
+    if memo is not None and memo[0] == path:
+        return memo[1]
+    with _trace_lock:
+        if _trace_memo is None or _trace_memo[0] != path:
+            old = _trace_memo[1] if _trace_memo else None
+            if old is not None and old is not sys.stderr:
+                try:
+                    old.close()
+                except OSError:
+                    pass
+            if path in ("stderr", "-"):
+                fh = sys.stderr
+            else:
+                try:
+                    fh = open(path, "a", encoding="utf-8")
+                except OSError:
+                    fh = None  # unwritable sink must never fail a decode
+            _trace_memo = (path, fh)
+        return _trace_memo[1]
+
+
+def _maybe_trace(span: Span) -> None:
+    fh = _trace_sink()
+    if fh is None:
+        return
+    try:
+        line = json.dumps(span.to_dict(), default=str)
+        with _trace_lock:
+            fh.write(line + "\n")
+            fh.flush()
+    except (OSError, ValueError):
+        pass  # a broken trace sink must never fail the call it observed
+
+
+# ---------------------------------------------------------------------------
+# report rendering (CLI: python -m pyruhvro_tpu.telemetry report <file>)
+# ---------------------------------------------------------------------------
+
+
+def _fmt_ms(v) -> str:
+    if v is None:
+        return "-"
+    if v == float("inf"):
+        return "inf"
+    return f"{v * 1e3:.3f}"
+
+
+def _phase_table(hists: Dict[str, Any], seconds: Dict[str, float]) -> List[str]:
+    header = (f"{'phase':<36} {'count':>7} {'total_s':>10} "
+              f"{'p50_ms':>9} {'p95_ms':>9} {'p99_ms':>9}")
+    rows = [header, "-" * len(header)]
+    for k in sorted(set(hists) | set(seconds)):
+        h = hists.get(k)
+        if h:
+            rows.append(
+                f"{k:<36} {h['count']:>7} {h['sum']:>10.4f} "
+                f"{_fmt_ms(h.get('p50')):>9} {_fmt_ms(h.get('p95')):>9} "
+                f"{_fmt_ms(h.get('p99')):>9}"
+            )
+        else:
+            rows.append(
+                f"{k:<36} {'-':>7} {seconds[k]:>10.4f} "
+                f"{'-':>9} {'-':>9} {'-':>9}"
+            )
+    return rows
+
+
+def _render_span(s: Dict[str, Any], indent: int, out: List[str]) -> None:
+    attrs = " ".join(f"{k}={v}" for k, v in s.get("attrs", {}).items())
+    dur = s.get("dur_s")
+    dur_txt = "-" if dur is None else f"{dur * 1e3:.3f} ms"
+    out.append("  " * indent + f"{s.get('name', '?')}  {dur_txt}"
+               + (f"  [{attrs}]" if attrs else ""))
+    for c in s.get("children", []):
+        _render_span(c, indent + 1, out)
+
+
+def render_report(data: Dict[str, Any]) -> str:
+    """Phase-breakdown table from a :func:`snapshot` dict or a
+    ``BENCH_DETAILS.json`` (each result's ``telemetry``/``metrics``)."""
+    out: List[str] = []
+    if "results" in data:  # BENCH_DETAILS.json
+        for r in data.get("results", []):
+            out.append(
+                f"{r.get('schema', '?')}/{r.get('op', '?')}"
+                f"[{r.get('backend', '?')}] rows={r.get('rows')} "
+                f"chunks={r.get('chunks')}"
+            )
+            sec = r.get("seconds")
+            if sec:
+                out.append(f"  best wall: {sec * 1e3:.3f} ms = "
+                           f"{r.get('records_per_s', 0):,.0f} rec/s "
+                           f"({r.get('vs_baseline', 0):.3f}x baseline)")
+            tel = r.get("telemetry") or {}
+            hists = tel.get("histograms") or {}
+            secs = {k: v for k, v in (r.get("metrics") or {}).items()
+                    if k.endswith("_s") and k not in hists}
+            if hists or secs:
+                out.extend("  " + line for line in _phase_table(hists, secs))
+            out.append("")
+        ov = data.get("telemetry_overhead")
+        if ov:
+            out.append(
+                f"telemetry overhead on {ov.get('workload', '?')}: "
+                f"{ov.get('overhead_frac', 0) * 100:.2f}% "
+                f"(enabled {ov.get('enabled_s', 0) * 1e3:.3f} ms, "
+                f"disabled {ov.get('disabled_s', 0) * 1e3:.3f} ms)"
+            )
+    else:  # telemetry snapshot
+        counters = data.get("counters", {})
+        hists = data.get("histograms", {})
+        out.append("== phase breakdown ==")
+        out.extend(_phase_table(
+            hists,
+            {k: v for k, v in counters.items()
+             if k.endswith("_s") and k not in hists},
+        ))
+        routes = {k: v for k, v in counters.items() if k.startswith("route.")}
+        if routes:
+            out += ["", "== routing =="]
+            out.extend(f"{k:<36} {v:>10.0f}" for k, v in sorted(routes.items()))
+        other = {k: v for k, v in counters.items()
+                 if not k.endswith("_s") and not k.startswith("route.")}
+        if other:
+            out += ["", "== counters =="]
+            out.extend(f"{k:<36} {v:>14.0f}" for k, v in sorted(other.items()))
+        spans = data.get("spans") or []
+        if spans:
+            out += ["", "== last call span =="]
+            _render_span(spans[-1], 0, out)
+    return "\n".join(out) + "\n"
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    """CLI: ``report <file>`` (phase table) / ``prom <file>`` (text
+    exposition). ``<file>`` is a saved :func:`snapshot` JSON or, for
+    ``report``, a ``BENCH_DETAILS.json``."""
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        prog="python -m pyruhvro_tpu.telemetry",
+        description="Render pyruhvro_tpu telemetry snapshots.",
+    )
+    sub = ap.add_subparsers(dest="cmd", required=True)
+    p_rep = sub.add_parser(
+        "report", help="phase-breakdown table from a snapshot or "
+                       "BENCH_DETAILS.json")
+    p_rep.add_argument("path", nargs="?", default="BENCH_DETAILS.json")
+    p_prom = sub.add_parser(
+        "prom", help="Prometheus text format from a snapshot JSON")
+    p_prom.add_argument("path")
+    args = ap.parse_args(argv)
+    try:
+        with open(args.path, encoding="utf-8") as f:
+            data = json.load(f)
+    except (OSError, ValueError) as e:
+        print(f"cannot read {args.path}: {e}", file=sys.stderr)
+        return 2
+    if args.cmd == "report":
+        sys.stdout.write(render_report(data))
+    else:
+        if "counters" not in data and "histograms" not in data:
+            print("not a telemetry snapshot (expected 'counters'/"
+                  "'histograms' keys)", file=sys.stderr)
+            return 2
+        sys.stdout.write(prometheus(data))
+    return 0

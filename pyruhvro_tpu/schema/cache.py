@@ -12,10 +12,12 @@ TPU design calls for (SURVEY.md §2, shared-schema amortization row).
 from __future__ import annotations
 
 import threading
+import time
 from typing import Dict, Optional
 
 import pyarrow as pa
 
+from ..runtime import metrics, telemetry
 from .arrow_map import to_arrow_schema
 from .model import AvroType
 from .parser import parse_schema
@@ -26,7 +28,7 @@ __all__ = ["SchemaEntry", "get_or_parse_schema", "clear_schema_cache"]
 class SchemaEntry:
     """Everything derived from one schema string, computed once."""
 
-    __slots__ = ("schema_str", "ir", "_arrow", "_lock", "_extras")
+    __slots__ = ("schema_str", "ir", "_arrow", "_lock", "_extras", "_fp")
 
     def __init__(self, schema_str: str, ir: AvroType):
         self.schema_str = schema_str
@@ -36,6 +38,19 @@ class SchemaEntry:
         # another extra (e.g. the device codec reads the Arrow schema)
         self._lock = threading.RLock()
         self._extras: Dict[str, object] = {}
+        self._fp: Optional[str] = None
+
+    @property
+    def fingerprint(self) -> str:
+        """Short stable id for this schema string (telemetry span attr —
+        spans must not drag whole schema JSON into snapshots/traces)."""
+        fp = self._fp
+        if fp is None:
+            import hashlib
+
+            fp = hashlib.sha1(self.schema_str.encode()).hexdigest()[:12]
+            self._fp = fp
+        return fp
 
     @property
     def arrow_schema(self) -> pa.Schema:
@@ -67,8 +82,12 @@ def get_or_parse_schema(schema_str: str) -> SchemaEntry:
     first sight (double-checked, like ``src/lib.rs:44-54``)."""
     entry = _cache.get(schema_str)
     if entry is not None:
+        metrics.inc("schema_cache.hits")
         return entry
+    metrics.inc("schema_cache.misses")
+    t0 = time.perf_counter()
     ir = parse_schema(schema_str)  # parse outside the lock; parsing is pure
+    telemetry.observe("schema_cache.parse_s", time.perf_counter() - t0)
     with _cache_lock:
         entry = _cache.get(schema_str)
         if entry is None:

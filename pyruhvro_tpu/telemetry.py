@@ -1,0 +1,19 @@
+"""Public alias + CLI entry for :mod:`pyruhvro_tpu.runtime.telemetry`.
+
+Usage::
+
+    python -m pyruhvro_tpu.telemetry report BENCH_DETAILS.json
+    python -m pyruhvro_tpu.telemetry report snapshot.json
+    python -m pyruhvro_tpu.telemetry prom snapshot.json
+
+(``scripts/metrics_report.py`` is the tier-1-safe wrapper over the same
+entry point.)
+"""
+
+import sys
+
+from .runtime.telemetry import *  # noqa: F401,F403
+from .runtime.telemetry import main
+
+if __name__ == "__main__":
+    sys.exit(main())

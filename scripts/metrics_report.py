@@ -1,0 +1,23 @@
+#!/usr/bin/env python
+"""Render a telemetry phase-breakdown report (tier-1-safe surface).
+
+Thin wrapper over ``python -m pyruhvro_tpu.telemetry`` so the report
+path is exercised by the unit suite (``tests/test_telemetry.py`` runs it
+against a checked-in sample snapshot) and can never bit-rot unnoticed.
+
+Usage::
+
+    python scripts/metrics_report.py report BENCH_DETAILS.json
+    python scripts/metrics_report.py report snapshot.json
+    python scripts/metrics_report.py prom snapshot.json
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from pyruhvro_tpu.runtime.telemetry import main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

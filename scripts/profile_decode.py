@@ -47,6 +47,7 @@ def main() -> None:
         deserialize_array_threaded,
         metrics,
         serialize_record_batch,
+        telemetry,
     )
     from pyruhvro_tpu.utils.datagen import (
         CRITERION_SHAPES,
@@ -81,7 +82,7 @@ def main() -> None:
 
     print(f"warmup (compiles)...", file=sys.stderr, flush=True)
     step()
-    metrics.reset()
+    telemetry.reset()  # spans + histograms + flat counters
 
     tracer = None
     if args.trace_dir:
@@ -100,6 +101,7 @@ def main() -> None:
         print(f"trace written to {args.trace_dir}", file=sys.stderr)
 
     snap = metrics.snapshot()
+    tsnap = telemetry.snapshot()
     rec_s = args.rows * args.iters / wall
     phases = {
         k: round(v, 6) for k, v in sorted(snap.items())
@@ -109,6 +111,15 @@ def main() -> None:
         for k, v in sorted(snap.items())
         if k.endswith("_s")
     }
+    # per-phase latency distributions across the hot loop (p50/p95/p99
+    # expose warmup tails and launch jitter the cumulative sums hide)
+    percentiles = {
+        k: {"count": h["count"],
+            "p50_ms": round(h["p50"] * 1e3, 3),
+            "p95_ms": round(h["p95"] * 1e3, 3),
+            "p99_ms": round(h["p99"] * 1e3, 3)}
+        for k, h in tsnap["histograms"].items()
+    }
     print(json.dumps({
         "op": args.op, "schema": args.schema, "backend": args.backend,
         "rows": args.rows, "iters": args.iters,
@@ -116,6 +127,8 @@ def main() -> None:
         "records_per_s": round(rec_s, 1),
         "per_iter_ms": per_iter_ms,
         "counters": phases,
+        "percentiles": percentiles,
+        "last_span": tsnap["spans"][-1] if tsnap["spans"] else None,
     }, indent=2))
 
 

@@ -20,7 +20,21 @@ config pinned back to cpu.
 import os
 import sys
 
+import pytest
+
 DEVICE_MODE = os.environ.get("PYRUHVRO_DEVICE_TEST") == "1"
+
+
+@pytest.fixture(autouse=True)
+def _telemetry_isolation():
+    """Span/histogram/counter isolation between tests (ISSUE 1): no test
+    observes telemetry produced by another. Imported lazily so the env
+    pinning above still runs before anything touches JAX."""
+    from pyruhvro_tpu.runtime import telemetry
+
+    telemetry.reset()
+    yield
+    telemetry.reset()
 
 if not DEVICE_MODE:
     os.environ["JAX_PLATFORMS"] = "cpu"

@@ -1,0 +1,332 @@
+"""Per-call span telemetry (ISSUE 1): span trees, routing explainers,
+histograms, exporters, thread-safety, and the report CLI surface.
+
+Runs entirely on the host tier (native VM when the toolchain is
+available, pure-Python fallback otherwise) — every assertion here must
+hold on BOTH, because tier-1 runs wherever the driver happens to be.
+"""
+
+import json
+import os
+import re
+import subprocess
+import sys
+import threading
+
+import pytest
+
+from pyruhvro_tpu import (
+    deserialize_array,
+    deserialize_array_threaded,
+    serialize_record_batch,
+    telemetry,
+)
+from pyruhvro_tpu.runtime import metrics
+from pyruhvro_tpu.schema.cache import get_or_parse_schema
+from pyruhvro_tpu.utils.datagen import random_datums
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+SCHEMA = json.dumps({
+    "type": "record",
+    "name": "TelemetryT",
+    "fields": [
+        {"name": "a", "type": "long"},
+        {"name": "b", "type": "string"},
+    ],
+})
+
+
+def _datums(n=100, seed=11):
+    return random_datums(get_or_parse_schema(SCHEMA).ir, n, seed=seed)
+
+
+def _walk(span, out):
+    for c in span.get("children", []):
+        out.append(c)
+        _walk(c, out)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# span trees + routing explainers
+# ---------------------------------------------------------------------------
+
+
+def test_span_tree_host_tier_has_route_and_phases():
+    """Acceptance: one threaded host-tier call → a span tree carrying the
+    routing reason and ≥ 3 named phase timings."""
+    data = _datums(200)
+    out = deserialize_array_threaded(data, SCHEMA, 4, backend="host")
+    assert len(out) == 4
+    snap = telemetry.snapshot()
+    assert snap["spans"], "no root span recorded"
+    root = snap["spans"][-1]
+    assert root["name"] == "api.deserialize_array_threaded"
+    assert root["dur_s"] > 0
+    assert root["attrs"]["backend"] == "host"
+    assert root["attrs"]["rows"] == 200
+    assert root["attrs"]["route"] in ("native", "fallback")
+    assert root["attrs"]["route_reason"] == "backend_host"
+    assert root["attrs"]["schema"] == get_or_parse_schema(SCHEMA).fingerprint
+    phases = _walk(root, [])
+    assert len(phases) >= 3, [p["name"] for p in phases]
+    assert all(p["dur_s"] is not None for p in phases)
+    assert all(p["name"].count(".") >= 1 for p in phases)  # component.event
+
+
+def test_route_counters_and_reason_auto(monkeypatch):
+    monkeypatch.setenv("PYRUHVRO_TPU_DEVICE_MIN_ROWS", "1000000")
+    data = _datums(10)
+    deserialize_array(data, SCHEMA, backend="auto")
+    snap = telemetry.snapshot()
+    root = snap["spans"][-1]
+    assert root["attrs"]["route"] in ("device", "native", "fallback")
+    reason = root["attrs"]["route_reason"]
+    assert isinstance(reason, str) and reason
+    # the routing verdict also lands in the flat counters
+    c = snap["counters"]
+    assert c.get("route." + root["attrs"]["route"], 0) >= 1
+    assert c.get("route.reason." + reason, 0) >= 1
+    if root["attrs"]["route"] == "native":
+        # below the env threshold, _auto_prefers_host must explain itself
+        assert reason in ("device_min_rows", "devices_cpu_only",
+                          "interconnect_remote")
+
+
+def test_device_failure_fallback_is_counted(monkeypatch):
+    """A broken device backend warns ONCE but counts EVERY fallback
+    (satellite: fallback storms must be visible in snapshots)."""
+    import pyruhvro_tpu.ops.codec as opc
+
+    def boom(entry):
+        raise RuntimeError("synthetic device breakage")
+
+    monkeypatch.setattr(opc, "get_device_codec", boom)
+    schema = json.dumps({
+        "type": "record", "name": "TelemetryBroken",
+        "fields": [{"name": "x", "type": "long"}],
+    })
+    data = random_datums(get_or_parse_schema(schema).ir, 8, seed=1)
+    with pytest.warns(RuntimeWarning, match="falling back"):
+        deserialize_array(data, schema, backend="auto")
+    deserialize_array(data, schema, backend="auto")  # cached failure path
+    snap = telemetry.snapshot()
+    assert snap["counters"].get("route.device_failure", 0) == 2
+    reasons = [s["attrs"].get("route_reason") for s in snap["spans"]]
+    assert "device_failure" in reasons
+    assert "device_failure_cached" in reasons
+
+
+def test_serialize_span_and_schema_cache_counters():
+    data = _datums(64)
+    batch = deserialize_array(data, SCHEMA, backend="host")
+    telemetry.reset()
+    serialize_record_batch(batch, SCHEMA, 2, backend="host")
+    snap = telemetry.snapshot()
+    root = snap["spans"][-1]
+    assert root["name"] == "api.serialize_record_batch"
+    assert root["attrs"]["route_reason"] == "backend_host"
+    assert root["attrs"]["rows"] == 64
+    # SCHEMA was parsed long ago: this call must count as a cache hit
+    assert snap["counters"].get("schema_cache.hits", 0) >= 1
+    assert snap["counters"].get("schema_cache.misses", 0) == 0
+
+
+# ---------------------------------------------------------------------------
+# histograms
+# ---------------------------------------------------------------------------
+
+
+def test_histogram_counts_and_percentiles():
+    data = _datums(50)
+    for _ in range(3):
+        deserialize_array(data, SCHEMA, backend="host")
+    snap = telemetry.snapshot()
+    h = snap["histograms"]["api.deserialize_array_s"]
+    assert h["count"] == 3
+    assert h["sum"] > 0
+    assert 0 < h["p50"] <= h["p95"] <= h["p99"]
+    # cumulative buckets end at +Inf == count
+    assert h["buckets"][-1][0] == "+Inf"
+    assert h["buckets"][-1][1] == 3
+    cums = [b[1] for b in h["buckets"]]
+    assert cums == sorted(cums)
+    # flat counter and histogram sum agree (same events)
+    assert abs(snap["counters"]["api.deserialize_array_s"] - h["sum"]) < 1e-6
+
+
+def test_observe_thread_safety():
+    """Counter/histogram updates must not lose events under contention."""
+    N, T = 1000, 8
+
+    def worker():
+        for _ in range(N):
+            telemetry.observe("t.contended_s", 0.001)
+
+    threads = [threading.Thread(target=worker) for _ in range(T)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    snap = telemetry.snapshot()
+    h = snap["histograms"]["t.contended_s"]
+    assert h["count"] == N * T
+    assert abs(h["sum"] - N * T * 0.001) < 1e-6
+    assert abs(snap["counters"]["t.contended_s"] - N * T * 0.001) < 1e-6
+
+
+def test_concurrent_threaded_calls_keep_span_accounting():
+    """Concurrent map_chunks fan-outs: every root accounted for, no
+    torn span trees."""
+    data = _datums(400)
+    deserialize_array_threaded(data, SCHEMA, 4, backend="host")  # warm caches
+    telemetry.reset()
+    CALLS, T = 5, 6
+    errs = []
+
+    def worker():
+        try:
+            for _ in range(CALLS):
+                deserialize_array_threaded(data, SCHEMA, 4, backend="host")
+        except Exception as e:  # noqa: BLE001 — surfaced by the assert
+            errs.append(e)
+
+    threads = [threading.Thread(target=worker) for _ in range(T)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errs
+    snap = telemetry.snapshot()
+    total = CALLS * T
+    assert snap["histograms"]["api.deserialize_array_threaded_s"]["count"] \
+        == total
+    assert len(snap["spans"]) + snap["spans_dropped"] == total
+    for s in snap["spans"]:
+        assert s["dur_s"] is not None
+        assert s["attrs"].get("route_reason") == "backend_host"
+
+
+# ---------------------------------------------------------------------------
+# exporters
+# ---------------------------------------------------------------------------
+
+_PROM_LINE = re.compile(
+    r'^[a-zA-Z_][a-zA-Z0-9_]*(\{le="(\+Inf|[0-9.e+-]+)"\})? \S+$')
+
+
+def test_prometheus_export_parses_line_by_line():
+    data = _datums(50)
+    deserialize_array_threaded(data, SCHEMA, 2, backend="host")
+    # JSON round-trip first: the snapshot must survive serialization
+    snap = json.loads(json.dumps(telemetry.snapshot()))
+    text = telemetry.prometheus(snap)
+    assert text
+    buckets = {}
+    for line in text.splitlines():
+        if line.startswith("# TYPE "):
+            assert re.match(
+                r"^# TYPE [a-zA-Z_][a-zA-Z0-9_]* (counter|histogram)$", line
+            ), line
+            continue
+        m = _PROM_LINE.match(line)
+        assert m, f"unparseable exposition line: {line!r}"
+        name, value = line.rsplit(" ", 1)
+        float(value)  # every sample value is numeric
+        if "_bucket{" in name:
+            base = name.split("_bucket{", 1)[0]
+            buckets.setdefault(base, []).append(
+                (name.split('le="', 1)[1].rstrip('"}'), float(value))
+            )
+    assert buckets, "no histogram families exported"
+    for base, series in buckets.items():
+        counts = [v for _le, v in series]
+        assert counts == sorted(counts), f"{base} buckets not cumulative"
+        assert series[-1][0] == "+Inf"
+
+
+def test_trace_stream_jsonl(tmp_path, monkeypatch):
+    p = tmp_path / "trace.jsonl"
+    monkeypatch.setenv("PYRUHVRO_TPU_TRACE", str(p))
+    data = _datums(20)
+    deserialize_array(data, SCHEMA, backend="host")
+    deserialize_array(data, SCHEMA, backend="host")
+    lines = p.read_text().strip().splitlines()
+    assert len(lines) == 2
+    for ln in lines:
+        d = json.loads(ln)
+        assert d["name"] == "api.deserialize_array"
+        assert d["dur_s"] > 0
+        assert d["attrs"]["route_reason"] == "backend_host"
+
+
+def test_reset_isolation():
+    telemetry.observe("t.reset_probe_s", 0.5)
+    assert telemetry.snapshot()["histograms"]
+    telemetry.reset()
+    snap = telemetry.snapshot()
+    assert snap["histograms"] == {}
+    assert snap["spans"] == []
+    assert snap["spans_dropped"] == 0
+    assert snap["counters"] == {}  # reset() clears the flat counters too
+
+
+def test_disabled_mode_keeps_counters_drops_spans():
+    data = _datums(30)
+    telemetry.set_enabled(False)
+    try:
+        deserialize_array(data, SCHEMA, backend="host")
+    finally:
+        telemetry.set_enabled(True)
+    snap = telemetry.snapshot()
+    assert snap["spans"] == []
+    assert snap["histograms"] == {}
+    # the always-on base layer still saw the call
+    assert snap["counters"].get("route.native", 0) \
+        + snap["counters"].get("route.fallback", 0) == 1
+
+
+# ---------------------------------------------------------------------------
+# report surface (CLI + renderer)
+# ---------------------------------------------------------------------------
+
+
+def test_render_report_from_live_snapshot():
+    data = _datums(30)
+    deserialize_array_threaded(data, SCHEMA, 2, backend="host")
+    out = telemetry.render_report(telemetry.snapshot())
+    assert "phase" in out
+    assert "api.deserialize_array_threaded_s" in out
+    assert "routing" in out
+    assert "backend_host" in out
+
+
+SAMPLE = os.path.join(REPO, "tests", "data",
+                      "telemetry_snapshot_sample.json")
+
+
+def _run_cli(args):
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               PYTHONPATH=REPO + os.pathsep + os.environ.get("PYTHONPATH", ""))
+    return subprocess.run(args, capture_output=True, text=True, env=env,
+                          cwd=REPO, timeout=180)
+
+
+def test_metrics_report_script_smoke():
+    """The tier-1-safe wrapper renders the checked-in sample snapshot."""
+    script = os.path.join(REPO, "scripts", "metrics_report.py")
+    r = _run_cli([sys.executable, script, "report", SAMPLE])
+    assert r.returncode == 0, r.stderr
+    assert "phase breakdown" in r.stdout
+    assert "host." in r.stdout
+    p = _run_cli([sys.executable, script, "prom", SAMPLE])
+    assert p.returncode == 0, p.stderr
+    assert '_bucket{le="+Inf"}' in p.stdout
+
+
+def test_telemetry_module_cli_smoke():
+    r = _run_cli([sys.executable, "-m", "pyruhvro_tpu.telemetry",
+                  "report", SAMPLE])
+    assert r.returncode == 0, r.stderr
+    assert "phase breakdown" in r.stdout
