@@ -68,6 +68,17 @@ class NativeHostCodec:
         except ValueError:
             self._spec_rows = self._SPECIALIZE_ROWS
         self._rows_seen = 0
+        # Arrow-native extraction (runtime/native/extract.cpp): probed
+        # lazily; PYRUHVRO_TPU_NO_NATIVE_EXTRACT=1 pins the Python
+        # extractor (the differential oracle for the native one)
+        self._extract_mod = None
+        self._extract_failed = (
+            os.environ.get("PYRUHVRO_TPU_NO_NATIVE_EXTRACT") == "1"
+        )
+        # the last Arrow schema the native extractor declined on SHAPE:
+        # repeated encodes of that shape skip the doomed C++ probe (and
+        # its duplicate struct build) instead of paying it per call
+        self._extract_declined_schema = None
 
     def _maybe_specialize(self, n: int) -> None:
         if self._spec is not None or self._spec_failed:
@@ -146,6 +157,7 @@ class NativeHostCodec:
         """Chunked decode → one RecordBatch per chunk (reference chunk
         slicing, ``deserialize.rs:57-68``); the VM threads shard rows
         internally within each decode."""
+        from ..ops.arrow_build import compact_union_slices
         from ..runtime.chunking import chunk_bounds
 
         bounds = chunk_bounds(len(data), num_chunks)
@@ -154,9 +166,114 @@ class NativeHostCodec:
                 self.decode(data[a:b], index_base=a) for a, b in bounds
             ]
         batch = self.decode(data)
-        return [batch.slice(a, b - a) for a, b in bounds]
+        return [
+            compact_union_slices(batch.slice(a, b - a)) for a, b in bounds
+        ]
 
     # -- encode -----------------------------------------------------------
+
+    def _native_extract_mod(self):
+        """The generic Arrow-native extractor module, or None (toolchain
+        missing, stale binary, or disabled by env). Probed once."""
+        if self._extract_failed:
+            return None
+        if self._extract_mod is None:
+            from ..runtime.native.build import load_extract
+
+            mod = load_extract()
+            if mod is None or not hasattr(mod, "encode"):
+                self._extract_failed = True
+                return None
+            self._extract_mod = mod
+        return self._extract_mod
+
+    @staticmethod
+    def _wrap_blob(blob, sizes, n: int) -> pa.Array:
+        from ..ops.arrow_build import cumsum0
+
+        sizes = np.frombuffer(sizes, np.int32)
+        offsets = cumsum0(sizes)  # VM bounds the total to int32
+        return pa.Array.from_buffers(
+            pa.binary(), n,
+            [None, pa.py_buffer(offsets),
+             pa.py_buffer(np.frombuffer(blob, np.uint8))],
+        )
+
+    def _encode_native(self, batch: pa.RecordBatch, n: int,
+                       checked: int) -> pa.Array:
+        """The fused Arrow-native encode: export the column-matched
+        struct through the Arrow C data interface and run extraction +
+        wire encode in ONE GIL-released C++ call — no Python/numpy
+        per-path arrays exist on this lane at all. Returns None when the
+        native lane declines (unsupported arrow shape, data error the
+        Python extractor words precisely, stale/missing module) — the
+        caller falls back to ``run_extractor`` and counts it."""
+        from ..ops.decode import BatchTooLarge
+        from ..ops.encode import batch_to_struct
+        from ..runtime import metrics, telemetry
+
+        if self._extract_failed:  # PYRUHVRO_TPU_NO_NATIVE_EXTRACT / probe
+            return None
+        if (self._extract_declined_schema is not None
+                and batch.schema.equals(self._extract_declined_schema)):
+            metrics.inc("extract.fallback")
+            metrics.inc("extract.fallback_shape")
+            return None
+        spec = self._spec if (
+            self._spec is not None and hasattr(self._spec, "encode_arrow")
+        ) else None
+        mod = None if spec is not None else self._native_extract_mod()
+        if spec is None and mod is None:
+            return None
+        struct = batch_to_struct(self.ir, batch)
+        # ArrowArray is 80 ABI bytes, ArrowSchema 72; the C++ side moves
+        # both structs out and releases them before returning
+        holder_a = np.zeros(10, np.uint64)
+        holder_s = np.zeros(9, np.uint64)
+        struct._export_to_c(
+            int(holder_a.ctypes.data), int(holder_s.ctypes.data)
+        )
+        try:
+            if spec is not None:
+                res = spec.encode_arrow(
+                    self.prog.coltypes, int(holder_a.ctypes.data),
+                    int(holder_s.ctypes.data), n, checked,
+                )
+            else:
+                res = mod.encode(
+                    self.prog.ops, self.prog.coltypes, self.prog.op_aux,
+                    int(holder_a.ctypes.data), int(holder_s.ctypes.data),
+                    n, checked,
+                )
+        except OverflowError as e:
+            if "decimal" in str(e):
+                raise  # oracle parity — a batch split cannot help
+            raise BatchTooLarge(n, -1)
+        except TypeError:
+            # a stale pinned .so with a pre-fused signature (build.py
+            # keeps a usable old binary when rebuild fails): disable the
+            # native lane for this codec instead of crashing every call
+            # — the buffer-fed path guards the same scenario below
+            self._extract_failed = True
+            metrics.inc("extract.fallback")
+            metrics.inc("extract.fallback_stale")
+            return None
+        if isinstance(res, int):
+            # 1 = arrow shape outside the native surface; 2 = a data
+            # error the Python extractor reports with its exact message
+            metrics.inc("extract.fallback")
+            metrics.inc("extract.fallback_data" if res == 2
+                        else "extract.fallback_shape")
+            if res == 1:
+                self._extract_declined_schema = batch.schema
+            return None
+        blob, sizes, t_ex, t_enc = res
+        telemetry.observe("host.extract_s", t_ex, rows=n, native=True)
+        telemetry.observe("host.extract_native_s", t_ex, rows=n)
+        telemetry.observe("host.encode_vm_s", t_enc, fused=True,
+                          specialized=spec is not None)
+        metrics.inc("extract.native")
+        return self._wrap_blob(blob, sizes, n)
 
     def _encode_buffers(self, ex) -> List[np.ndarray]:
         """Map the shared Arrow extractor's per-path arrays
@@ -212,15 +329,6 @@ class NativeHostCodec:
                 # int32 — the same capacity condition the single-pass VM
                 # reports, surfaced through the library's contract
                 raise BatchTooLarge(n, -1)
-        with telemetry.phase("host.extract_s", rows=n):
-            ex = run_extractor(self.ir, batch, host_mode=True)
-            bufs = self._encode_buffers(ex)
-        # the extractor's bound is a STRICT upper bound on the wire
-        # total (loose: 10 B/long regardless of varint width), which
-        # lets the VM write unchecked into a single allocation of that
-        # size; past 1 GiB of bound, hint=0 selects the VM's
-        # capacity-checked growth path instead of a giant eager alloc
-        hint = ex.bound if ex.bound <= (1 << 30) else 0
         self._maybe_specialize(n)
         # PYRUHVRO_DEBUG_BOUNDS=1: the writer verifies every store
         # against the extractor's bound instead of trusting it — a bound
@@ -229,6 +337,21 @@ class NativeHostCodec:
         import os
 
         checked = 1 if os.environ.get("PYRUHVRO_DEBUG_BOUNDS") == "1" else 0
+        # fast lane: Arrow-native fused extract+encode (one GIL-released
+        # C++ call straight off the Arrow buffers); None → the Python
+        # extractor below serves the call (counted as extract.fallback)
+        out = self._encode_native(batch, n, checked)
+        if out is not None:
+            return out
+        with telemetry.phase("host.extract_s", rows=n, native=False):
+            ex = run_extractor(self.ir, batch, host_mode=True)
+            bufs = self._encode_buffers(ex)
+        # the extractor's bound is a STRICT upper bound on the wire
+        # total (loose: 10 B/long regardless of varint width), which
+        # lets the VM write unchecked into a single allocation of that
+        # size; past 1 GiB of bound, hint=0 selects the VM's
+        # capacity-checked growth path instead of a giant eager alloc
+        hint = ex.bound if ex.bound <= (1 << 30) else 0
         try:
             with telemetry.phase("host.encode_vm_s",
                                  specialized=self._spec is not None):
@@ -263,15 +386,7 @@ class NativeHostCodec:
                 raise  # oracle parity (int.to_bytes overflow) — a
                 # batch split cannot make the value fit
             raise BatchTooLarge(n, -1)
-        from ..ops.arrow_build import cumsum0
-
-        sizes = np.frombuffer(sizes, np.int32)
-        offsets = cumsum0(sizes)  # VM bounds the total to int32
-        return pa.Array.from_buffers(
-            pa.binary(), n,
-            [None, pa.py_buffer(offsets),
-             pa.py_buffer(np.frombuffer(blob, np.uint8))],
-        )
+        return self._wrap_blob(blob, sizes, n)
 
     def encode_threaded(self, batch: pa.RecordBatch,
                         num_chunks: int) -> List[pa.Array]:
@@ -285,10 +400,19 @@ class NativeHostCodec:
         bounds = chunk_bounds(batch.num_rows, num_chunks)
         if batch.num_rows >= self._PER_CHUNK_ROWS * max(len(bounds), 1):
             # large chunks: one encode per chunk (cache-resident working
-            # set, ≙ the reference's per-chunk serialize fan-out)
-            return [
-                self._encode_split(batch.slice(a, b - a)) for a, b in bounds
-            ]
+            # set, ≙ the reference's per-chunk serialize fan-out), run
+            # on the process pool — the fused Arrow-native encode
+            # releases the GIL for essentially the whole call, so chunk
+            # encodes genuinely overlap on multi-core hosts (the encode
+            # analogue of the decode VM's internal row sharding)
+            from ..runtime.pool import map_chunks
+
+            return map_chunks(
+                lambda ab: self._encode_split(
+                    batch.slice(ab[0], ab[1] - ab[0])
+                ),
+                bounds,
+            )
         arr = self._encode_split(batch)
         return [arr.slice(a, b - a) for a, b in bounds]
 

@@ -74,6 +74,11 @@ class HostProgram:
     coltypes: np.ndarray       # int32 [n_cols]
     regions: List[str]         # region id -> repeated-field path
     region_parents: List[int]
+    # per-op logical facts the flat opcode table cannot carry, shaped
+    # for the Arrow-native extractor (runtime/native/extract_core.h):
+    # one entry per op — None, ("uuid",), ("duration",) or
+    # ("enum", symbol_bytes, ...)
+    op_aux: tuple = ()
 
     def buffer_plan(self) -> List[Tuple[str, object, int]]:
         """Flat (host_key, dtype, region) per returned buffer, in the
@@ -97,6 +102,7 @@ class _HostLowering:
         self.subtree: Dict[int, int] = {}  # op index -> nops
         self.regions: List[str] = [""]
         self.region_parents: List[int] = [-1]
+        self.aux: Dict[int, tuple] = {}    # op index -> extractor aux
 
     def col(self, key: str, ctype: int, region: int) -> int:
         self.cols.append(ColSpec(key, ctype, region))
@@ -129,8 +135,12 @@ class _HostLowering:
                 self.emit(OP_BOOL, col=self.col(path + "#v", COL_U8, region))
             elif name == "string":
                 # incl. uuid: the wire form is a plain string; the
-                # text→16-byte conversion is the assembler's job
-                self.emit(OP_STRING, col=self.col(path, COL_STR, region))
+                # text→16-byte conversion is the assembler's job (the
+                # aux tag tells the Arrow-native extractor the column
+                # arrives as FixedSizeBinary(16), not text)
+                i = self.emit(OP_STRING, col=self.col(path, COL_STR, region))
+                if t.logical == "uuid":
+                    self.aux[i] = ("uuid",)
             elif name == "bytes":
                 if t.logical == "decimal":
                     # wire: length-prefixed big-endian two's complement;
@@ -149,11 +159,16 @@ class _HostLowering:
                 self.emit(OP_DEC_FIXED, a=t.size,
                           col=self.col(path + "#dec", COL_U8, region))
             else:
-                self.emit(OP_FIXED, a=t.size,
-                          col=self.col(path + "#fix", COL_U8, region))
+                i = self.emit(OP_FIXED, a=t.size,
+                              col=self.col(path + "#fix", COL_U8, region))
+                if t.logical == "duration":
+                    self.aux[i] = ("duration",)
         elif isinstance(t, Enum):
-            self.emit(OP_ENUM, a=len(t.symbols),
-                      col=self.col(path + "#v", COL_I32, region))
+            i = self.emit(OP_ENUM, a=len(t.symbols),
+                          col=self.col(path + "#v", COL_I32, region))
+            self.aux[i] = ("enum",) + tuple(
+                s.encode("utf-8") for s in t.symbols
+            )
         elif isinstance(t, Record):
             i = self.emit(OP_RECORD)
             prefix = path + "/" if path else ""
@@ -217,4 +232,5 @@ def lower_host(ir: AvroType) -> HostProgram:
         ),
         regions=lo.regions,
         region_parents=lo.region_parents,
+        op_aux=tuple(lo.aux.get(i) for i in range(n)),
     )

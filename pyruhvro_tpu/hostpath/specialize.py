@@ -47,11 +47,18 @@ from .program import (  # noqa: E402  (kept near use for readability)
 class _GenBase:
     """Shared emitter scaffolding for the two code generators.
 
-    ``present`` threads through ``gen`` as either the literal ``True``
-    (field is statically reached — the dominant case, which compiles to
-    branchless straight-line reads) or the name of a C ``bool`` local
-    minted by the enclosing nullable/union.
+    ``present`` threads through ``gen`` as the literal ``True`` (field
+    is statically reached — the dominant case, which compiles to
+    branchless straight-line reads), the literal ``False`` (field is
+    statically ABSENT: emit pure default-appends / cursor-skips with no
+    wire access — the branch-table arms), or the name of a C ``bool``
+    local minted by an enclosing nullable/union.
     """
+
+    # branch-table / two-version codegen is skipped for subtrees larger
+    # than this many ops: each union arm (or nullable side) duplicates
+    # the whole subtree body, so the cap bounds code-size blowup
+    _BRANCH_TABLE_MAX_OPS = 48
 
     def __init__(self, ops: np.ndarray, indent: int):
         self.ops = ops
@@ -71,6 +78,16 @@ class _GenBase:
         self.uid += 1
         return self.uid
 
+    def subtree_branchy(self, pc: int) -> bool:
+        """Does the subtree at ``pc`` contain nullable/union nodes?
+        Two-version nullable codegen is limited to branch-free inners so
+        nesting cannot double code size per level."""
+        stop = pc + int(self.ops[pc][4])
+        for q in range(pc, stop):
+            if int(self.ops[q][0]) in (OP_NULLABLE, OP_UNION):
+                return True
+        return False
+
 
 class _Gen(_GenBase):
     """Emit the decode body for one opcode subtree."""
@@ -78,9 +95,64 @@ class _Gen(_GenBase):
     def __init__(self, ops: np.ndarray):
         super().__init__(ops, indent=1)
 
+    def gen_default(self, pc: int) -> int:
+        """The statically-ABSENT body: pure default appends, no wire
+        reads — what ``Vm::exec(present=false)`` does per row, unrolled.
+        The branch-table union arms and the null side of two-version
+        nullables are built from this."""
+        kind, a, b, col, nops, _pad = (int(x) for x in self.ops[pc])
+        if kind == OP_RECORD:
+            q = pc + 1
+            stop = pc + nops
+            while q < stop:
+                q = self.gen_default(q)
+            return q
+        if kind in (OP_INT, OP_ENUM):
+            self.w(f"{self.c(col)}.i32.push_back(0);")
+            return pc + 1
+        if kind == OP_LONG:
+            self.w(f"{self.c(col)}.i64.push_back(0);")
+            return pc + 1
+        if kind == OP_FLOAT:
+            self.w(f"{self.c(col)}.f32.push_back(0.f);")
+            return pc + 1
+        if kind == OP_DOUBLE:
+            self.w(f"{self.c(col)}.f64.push_back(0.0);")
+            return pc + 1
+        if kind == OP_BOOL:
+            self.w(f"{self.c(col)}.u8.push_back(0);")
+            return pc + 1
+        if kind == OP_STRING:
+            self.w(f"{self.c(col)}.i32.push_back(0);")
+            return pc + 1
+        if kind == OP_FIXED:
+            self.w(f"{self.c(col)}.u8.append_fill({a}, 0);")
+            return pc + 1
+        if kind in (OP_DEC_BYTES, OP_DEC_FIXED):
+            self.w(f"{self.c(col)}.u8.append_fill(16, 0);")
+            return pc + 1
+        if kind == OP_NULL:
+            return pc + 1
+        if kind == OP_NULLABLE:
+            self.w(f"{self.c(col)}.u8.push_back(0);")
+            return self.gen_default(pc + 1)
+        if kind == OP_UNION:
+            self.w(f"{self.c(col)}.i32.push_back(0);")
+            q = pc + 1
+            for _ in range(a):
+                q = self.gen_default(q)
+            return q
+        if kind in (OP_ARRAY, OP_MAP):
+            offs = self.c(col)
+            self.w(f"{offs}.i32.push_back({offs}.running);")
+            return pc + 1 + int(self.ops[pc + 1][4])
+        raise AssertionError(f"unknown op kind {kind}")  # pragma: no cover
+
     def gen(self, pc: int, present) -> int:
         """Generate code for the subtree at ``pc``; return next pc.
         Mirrors ``Vm::exec`` (host_codec.cpp) case-for-case."""
+        if present is False:
+            return self.gen_default(pc)
         kind, a, b, col, nops, _pad = (int(x) for x in self.ops[pc])
         p = "true" if present is True else present
 
@@ -145,6 +217,11 @@ class _Gen(_GenBase):
 
         if kind == OP_NULLABLE:
             u = self.fresh()
+            two_version = (
+                present is True
+                and nops <= self._BRANCH_TABLE_MAX_OPS
+                and not self.subtree_branchy(pc + 1)
+            )
             self.w(f"uint8_t valid{u} = 0; bool p{u} = false;")
             body = (f"int64_t br{u} = r.read_zigzag(); "
                     f"if (br{u} == {1 - a}) "
@@ -153,7 +230,23 @@ class _Gen(_GenBase):
             self.w("{ " + body + " }" if present is True
                    else f"if ({p}) {{ {body} }}")
             self.w(f"{self.c(col)}.u8.push_back(valid{u});")
-            return self.gen(pc + 1, f"p{u}")
+            if not two_version:
+                return self.gen(pc + 1, f"p{u}")
+            # hoist the null-branch check out of the per-leaf path: the
+            # live side compiles branchless, the null side is pure
+            # default stores (ISSUE 2 fast lane; bounded by the op cap
+            # and branch-free inners so nesting cannot blow up code size)
+            self.w(f"(void)p{u};")
+            self.w(f"if (valid{u}) {{")
+            self.indent += 1
+            end = self.gen(pc + 1, True)
+            self.indent -= 1
+            self.w("} else {")
+            self.indent += 1
+            self.gen_default(pc + 1)
+            self.indent -= 1
+            self.w("}")
+            return end
 
         if kind == OP_UNION:
             u = self.fresh()
@@ -165,6 +258,38 @@ class _Gen(_GenBase):
             self.w("{ " + body + " }" if present is True
                    else f"if ({p}) {{ {body} }}")
             self.w(f"{self.c(col)}.i32.push_back(tid{u});")
+            if nops <= self._BRANCH_TABLE_MAX_OPS:
+                # branch-table dispatch: one switch per row; the
+                # selected arm decodes straight-line while the others
+                # emit their default stores — replaces the per-arm
+                # bool-flag chain that re-tested the branch at every leaf
+                arm_pcs = []
+                q = pc + 1
+                for _ in range(a):
+                    arm_pcs.append(q)
+                    q += int(self.ops[q][4])
+                self.w(f"switch (tid{u}) {{")
+                for k, apc in enumerate(arm_pcs):
+                    self.w(f"case {k}: {{")
+                    self.indent += 1
+                    for j, jpc in enumerate(arm_pcs):
+                        if j == k:
+                            self.gen(jpc, present)
+                        else:
+                            self.gen_default(jpc)
+                    self.indent -= 1
+                    self.w("} break;")
+                # tids are range-checked upstream; the default arm keeps
+                # the appends/cursors in sync regardless (the VM's
+                # every-arm-absent behavior)
+                self.w("default: {")
+                self.indent += 1
+                for jpc in arm_pcs:
+                    self.gen_default(jpc)
+                self.indent -= 1
+                self.w("} break;")
+                self.w("}")
+                return q
             q = pc + 1
             for k in range(a):
                 sel = (f"tid{u} == {k}" if present is True
@@ -229,7 +354,64 @@ class _EncGen(_GenBase):
     def __init__(self, ops: np.ndarray):
         super().__init__(ops, indent=2)
 
+    def gen_default(self, pc: int) -> int:
+        """The statically-ABSENT encode body: advance the entry cursors
+        without emitting a byte — what ``EncVm::exec(present=false)``
+        does, unrolled (non-selected union arms, null nullable sides)."""
+        kind, a, b, col, nops, _pad = (int(x) for x in self.ops[pc])
+        if kind == OP_RECORD:
+            q = pc + 1
+            stop = pc + nops
+            while q < stop:
+                q = self.gen_default(q)
+            return q
+        if kind in (OP_INT, OP_ENUM, OP_LONG, OP_FLOAT, OP_DOUBLE, OP_BOOL):
+            C = self.c(col)
+            self.w(f"{C}.cur++;")
+            return pc + 1
+        if kind == OP_STRING:
+            C = self.c(col)
+            self.w(f"{C}.bcur += (size_t){C}.i32[{C}.cur++];")
+            return pc + 1
+        if kind == OP_FIXED:
+            C = self.c(col)
+            self.w(f"{C}.cur += {a};")
+            return pc + 1
+        if kind in (OP_DEC_BYTES, OP_DEC_FIXED):
+            C = self.c(col)
+            self.w(f"{C}.cur += 16;")
+            return pc + 1
+        if kind == OP_NULL:
+            return pc + 1
+        if kind == OP_NULLABLE:
+            C = self.c(col)
+            self.w(f"{C}.cur++;")
+            return self.gen_default(pc + 1)
+        if kind == OP_UNION:
+            C = self.c(col)
+            self.w(f"{C}.cur++;")
+            q = pc + 1
+            for _ in range(a):
+                q = self.gen_default(q)
+            return q
+        if kind in (OP_ARRAY, OP_MAP):
+            u = self.fresh()
+            C = self.c(col)
+            self.w(f"int32_t cnt{u} = {C}.i32[{C}.cur++];")
+            self.w(f"for (int32_t i{u} = 0; i{u} < cnt{u}; i{u}++) {{")
+            self.indent += 1
+            if kind == OP_MAP:
+                K = self.c(b)
+                self.w(f"{K}.bcur += (size_t){K}.i32[{K}.cur++];")
+            inner_end = self.gen_default(pc + 1)
+            self.indent -= 1
+            self.w("}")
+            return inner_end
+        raise AssertionError(f"unknown op kind {kind}")  # pragma: no cover
+
     def gen(self, pc: int, present) -> int:
+        if present is False:
+            return self.gen_default(pc)
         kind, a, b, col, nops, _pad = (int(x) for x in self.ops[pc])
         p = "true" if present is True else present
 
@@ -295,6 +477,20 @@ class _EncGen(_GenBase):
             wr = (f"write_zigzag(out, valid{u} ? (int64_t){1 - a} "
                   f": (int64_t){a});")
             self.w(wr if present is True else f"if ({p}) {wr}")
+            if (present is True and nops <= self._BRANCH_TABLE_MAX_OPS
+                    and not self.subtree_branchy(pc + 1)):
+                # hoisted null check: live side writes branchless, null
+                # side is pure cursor skips (mirrors the decode gen)
+                self.w(f"if (valid{u}) {{")
+                self.indent += 1
+                end = self.gen(pc + 1, True)
+                self.indent -= 1
+                self.w("} else {")
+                self.indent += 1
+                self.gen_default(pc + 1)
+                self.indent -= 1
+                self.w("}")
+                return end
             v = self.fresh()
             sel = (f"valid{u} != 0" if present is True
                    else f"{p} && valid{u}")
@@ -307,6 +503,37 @@ class _EncGen(_GenBase):
             self.w(f"int32_t tid{u} = {C}.i32[{C}.cur++];")
             wr = f"write_zigzag(out, (int64_t)tid{u});"
             self.w(wr if present is True else f"if ({p}) {wr}")
+            if nops <= self._BRANCH_TABLE_MAX_OPS:
+                # branch-table dispatch (mirrors the decode gen): the
+                # selected arm encodes straight-line, the others skip
+                # their cursors
+                arm_pcs = []
+                q = pc + 1
+                for _ in range(a):
+                    arm_pcs.append(q)
+                    q += int(self.ops[q][4])
+                self.w(f"switch (tid{u}) {{")
+                for k, apc in enumerate(arm_pcs):
+                    self.w(f"case {k}: {{")
+                    self.indent += 1
+                    for j, jpc in enumerate(arm_pcs):
+                        if j == k:
+                            self.gen(jpc, present)
+                        else:
+                            self.gen_default(jpc)
+                    self.indent -= 1
+                    self.w("} break;")
+                # tids are range-checked upstream; the default arm keeps
+                # the appends/cursors in sync regardless (the VM's
+                # every-arm-absent behavior)
+                self.w("default: {")
+                self.indent += 1
+                for jpc in arm_pcs:
+                    self.gen_default(jpc)
+                self.indent -= 1
+                self.w("} break;")
+                self.w("}")
+                return q
             q = pc + 1
             for k in range(a):
                 sel = (f"tid{u} == {k}" if present is True
@@ -340,12 +567,18 @@ class _EncGen(_GenBase):
 _TEMPLATE = """\
 // AUTO-GENERATED by pyruhvro_tpu.hostpath.specialize — DO NOT EDIT.
 // One schema's HostProgram unrolled into straight-line C++ over the
-// shared decode core (host_vm_core.h). Regenerated whenever the
-// program or the core changes (content-hashed module name).
+// shared decode/extract cores (host_vm_core.h, extract_core.h).
+// Regenerated whenever the program or a core changes (content-hashed
+// module name). The embedded opcode/aux tables feed the Arrow-native
+// extraction pass, fused ahead of the generated encoder in
+// encode_arrow — no VM dispatch anywhere between the Arrow buffers
+// and the wire bytes.
 #include "{core}"
 
 namespace {{
 using namespace pyr;
+
+{static_tables}
 
 inline void decode_record(Reader& r, std::vector<Col>& cols) {{
 {col_refs}
@@ -383,11 +616,27 @@ PyObject* py_encode_spec(PyObject*, PyObject* args) {{
                          checked);
 }}
 
+PyObject* py_encode_arrow_spec(PyObject*, PyObject* args) {{
+  PyObject* coltypes_obj;
+  unsigned long long addr_a, addr_s;
+  Py_ssize_t n;
+  int checked = 0;
+  if (!PyArg_ParseTuple(args, "OKKn|i", &coltypes_obj, &addr_a, &addr_s,
+                        &n, &checked))
+    return nullptr;
+  return encode_arrow_boundary(EncRec{{}}, kOps, kAux, coltypes_obj,
+                               (uintptr_t)addr_a, (uintptr_t)addr_s, n,
+                               checked);
+}}
+
 PyMethodDef methods[] = {{
     {{"decode", py_decode_spec, METH_VARARGS,
      "decode(coltypes, data, nthreads=0) -> (buffers, err_record, err_bits)"}},
     {{"encode", py_encode_spec, METH_VARARGS,
      "encode(coltypes, buffers, n, size_hint=0) -> (blob, sizes)"}},
+    {{"encode_arrow", py_encode_arrow_spec, METH_VARARGS,
+     "encode_arrow(coltypes, addr_array, addr_schema, n, checked=0)"
+     " -> (blob, sizes, t_extract_s, t_encode_s) | status int"}},
     {{nullptr, nullptr, 0, nullptr}},
 }};
 
@@ -404,8 +653,47 @@ extern "C" PyMODINIT_FUNC PyInit_{mod}(void) {{
 """
 
 
+def _static_tables(prog: HostProgram) -> str:
+    """The embedded opcode + aux tables the fused Arrow-native
+    extraction walks (extract_core.h ArrowExtractor)."""
+    lines = ["static const Op kOps[] = {"]
+    for row in prog.ops:
+        kind, a, b, col, nops, _pad = (int(x) for x in row)
+        lines.append(f"    {{{kind}, {a}, {b}, {col}, {nops}, 0}},")
+    lines.append("};")
+    aux = prog.op_aux or tuple(None for _ in range(len(prog.ops)))
+    entries = []
+    for i, e in enumerate(aux):
+        if e is None:
+            entries.append("    {AUX_NONE, nullptr, nullptr, 0},")
+        elif e[0] == "uuid":
+            entries.append("    {AUX_UUID, nullptr, nullptr, 0},")
+        elif e[0] == "duration":
+            entries.append("    {AUX_DURATION, nullptr, nullptr, 0},")
+        else:  # ("enum", symbol_bytes, ...)
+            syms = e[1:]
+            for k, s in enumerate(syms):
+                bs = ", ".join(str(x) for x in s) + ", 0" if s else "0"
+                lines.append(f"static const char kSym_{i}_{k}[] = {{{bs}}};")
+            ptrs = ", ".join(f"kSym_{i}_{k}" for k in range(len(syms)))
+            lens = ", ".join(str(len(s)) for s in syms)
+            lines.append(
+                f"static const char* const kSyms_{i}[] = {{{ptrs}}};"
+            )
+            lines.append(
+                f"static const int32_t kSymLens_{i}[] = {{{lens}}};"
+            )
+            entries.append(
+                f"    {{AUX_ENUM, kSyms_{i}, kSymLens_{i}, {len(syms)}}},"
+            )
+    lines.append("static const OpAux kAux[] = {")
+    lines.extend(entries)
+    lines.append("};")
+    return "\n".join(lines)
+
+
 def generate_source(prog: HostProgram, mod_name: str,
-                    core_include: str = "../host_vm_core.h") -> str:
+                    core_include: str = "../extract_core.h") -> str:
     """The C++ translation unit for one schema's decoder + encoder."""
     g = _Gen(prog.ops)
     g.gen(0, True)
@@ -420,6 +708,7 @@ def generate_source(prog: HostProgram, mod_name: str,
     return _TEMPLATE.format(
         core=core_include,
         mod=mod_name,
+        static_tables=_static_tables(prog),
         col_refs=col_refs,
         body="\n".join(g.lines),
         enc_col_refs=enc_col_refs,
@@ -447,9 +736,10 @@ def load_specialized(prog: HostProgram):
 
     spec_dir = os.path.join(_native_dir(), "_spec")
     try:
-        core_path = os.path.join(_native_dir(), "host_vm_core.h")
-        with open(core_path) as f:
-            core_text = f.read()
+        core_text = ""
+        for name in ("host_vm_core.h", "extract_core.h"):
+            with open(os.path.join(_native_dir(), name)) as f:
+                core_text += f.read() + "\x00"
         probe = generate_source(prog, "M")  # name-independent content
         h = hashlib.sha256(
             (probe + "\x00" + core_text).encode()

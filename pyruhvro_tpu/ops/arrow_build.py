@@ -35,7 +35,42 @@ from ..schema.model import (
     Union,
 )
 
-__all__ = ["build_record_batch"]
+__all__ = ["build_record_batch", "compact_union_slices"]
+
+
+def _contains_union(dt: pa.DataType) -> bool:
+    if pa.types.is_union(dt):
+        return True
+    if pa.types.is_struct(dt) or pa.types.is_map(dt):
+        return any(_contains_union(dt.field(i).type)
+                   for i in range(dt.num_fields))
+    if pa.types.is_list(dt) or pa.types.is_large_list(dt):
+        return _contains_union(dt.value_type)
+    return False
+
+
+def compact_union_slices(batch: pa.RecordBatch) -> pa.RecordBatch:
+    """Repair a SLICED batch whose columns contain sparse unions:
+    pyarrow's scalar access mis-reads a sparse union reached through a
+    non-zero offset when its children hold validity bitmaps
+    (``to_pylist``/``as_py`` return null for every row — reproducible on
+    a pure ``pa.UnionArray.from_sparse(...).slice(...)`` with pyarrow
+    22, and equally through a sliced struct PARENT, where the offset
+    lives on the struct and the union child still mis-resolves).
+    ``pa.concat_arrays`` of the single slice compacts it back to offset
+    0 — children included — copying only the union-bearing columns;
+    every other column stays the zero-copy slice. A batch with no
+    union-bearing columns (or no offset) is returned untouched — this
+    keeps the reference's slice-per-chunk shape (``deserialize.rs:57-68``)
+    while making the returned chunks render correctly."""
+    if not any(_contains_union(f.type) for f in batch.schema):
+        return batch
+    cols = [
+        pa.concat_arrays([c]) if _contains_union(c.type) and c.offset
+        else c
+        for c in batch.columns
+    ]
+    return pa.RecordBatch.from_arrays(cols, schema=batch.schema)
 
 
 def _validity(valid: Optional[np.ndarray], count: int):

@@ -22,6 +22,7 @@ import pyarrow as pa
 
 from ..schema.cache import SchemaEntry
 from . import UnsupportedOnDevice
+from .arrow_build import compact_union_slices
 from .decode import BatchTooLarge, DeviceCapacityExceeded, DeviceDecoder
 
 __all__ = ["DeviceCodec", "get_device_codec"]
@@ -277,9 +278,14 @@ class DeviceCodec:
                     # mesh shards used reference slicing too → exact match
                     return batches
                 whole = _concat_batches(batches)
-                return [whole.slice(a, b - a) for a, b in bounds]
+                return [
+                    compact_union_slices(whole.slice(a, b - a))
+                    for a, b in bounds
+                ]
         batch = self.decode(data)
-        return [batch.slice(a, b - a) for a, b in bounds]
+        return [
+            compact_union_slices(batch.slice(a, b - a)) for a, b in bounds
+        ]
 
     def encode_threaded(self, batch: pa.RecordBatch,
                         num_chunks: int) -> List[pa.Array]:

@@ -1181,17 +1181,17 @@ class _Extractor:
             self.extract(t.values, vals, path + "/@val", rid, item_parent)
 
 
-def run_extractor(ir: Record, batch: pa.RecordBatch,
-                  host_mode: bool = False) -> "_Extractor":
-    """Column-match an Arrow batch against the schema and walk it into
-    per-path numpy arrays (shared by the device encoder and the native
-    host encoder). Columns are matched by NAME (missing → error, extras
-    ignored), exactly like the oracle and the reference
-    (``serialization_containers.rs:248-267``)."""
+def batch_to_struct(ir: Record, batch: pa.RecordBatch) -> pa.StructArray:
+    """Column-match an Arrow batch against the schema → one StructArray
+    mirroring the IR's field order. Columns are matched by NAME
+    (missing → error, extras ignored), exactly like the oracle and the
+    reference (``serialization_containers.rs:248-267``). Shared by the
+    Python extractor walk below and the Arrow-native C++ extractor
+    (``hostpath/codec.py`` exports this struct through the Arrow C data
+    interface)."""
     from ..fallback.encoder import _types_compatible
     from ..schema.arrow_map import to_arrow_field
 
-    ex = _Extractor(host_mode)
     cols = []
     for f in ir.fields:
         idx = batch.schema.get_field_index(f.name)
@@ -1208,10 +1208,17 @@ def run_extractor(ir: Record, batch: pa.RecordBatch,
                 f"schema requires {expected}"
             )
         cols.append(batch.column(idx))
-    struct = pa.StructArray.from_arrays(
+    return pa.StructArray.from_arrays(
         cols, names=[f.name for f in ir.fields]
     ) if cols else pa.array([{}] * batch.num_rows, pa.struct([]))
-    ex.extract(ir, struct, "", ROWS, None)
+
+
+def run_extractor(ir: Record, batch: pa.RecordBatch,
+                  host_mode: bool = False) -> "_Extractor":
+    """Walk a column-matched Arrow batch into per-path numpy arrays
+    (shared by the device encoder and the native host encoder)."""
+    ex = _Extractor(host_mode)
+    ex.extract(ir, batch_to_struct(ir, batch), "", ROWS, None)
     return ex
 
 

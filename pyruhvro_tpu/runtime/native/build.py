@@ -52,10 +52,11 @@ def _cpu_tag() -> str:
 
 def _needs_build(so: str, src: str) -> bool:
     src_mtime = os.path.getmtime(src)
-    # editing the shared core header must rebuild its includers too
-    hdr = os.path.join(_HERE, "host_vm_core.h")
-    if os.path.exists(hdr):
-        src_mtime = max(src_mtime, os.path.getmtime(hdr))
+    # editing a shared core header must rebuild its includers too
+    for name in ("host_vm_core.h", "extract_core.h"):
+        hdr = os.path.join(_HERE, name)
+        if os.path.exists(hdr):
+            src_mtime = max(src_mtime, os.path.getmtime(hdr))
     if (not os.path.exists(so)) or os.path.getmtime(so) < src_mtime:
         return True
     try:
@@ -154,3 +155,9 @@ def load_native():
 def load_host_codec():
     """The host decode/encode VM, or None if the toolchain is missing."""
     return _load("_pyruhvro_hostcodec", "host_codec.cpp")
+
+
+def load_extract():
+    """The Arrow-native extractor / fused encoder, or None if the
+    toolchain is missing (callers keep the Python extractor)."""
+    return _load("_pyruhvro_extract", "extract.cpp")

@@ -1,0 +1,151 @@
+// Generic Arrow-native extractor module (_pyruhvro_extract): the
+// table-driven twin of the extraction core in extract_core.h, serving
+// ANY HostProgram with zero compile latency — the same economics split
+// as host_codec.cpp (generic VM) vs hostpath/specialize.py (straight-
+// line per-schema modules, which embed their opcode/aux tables and fuse
+// this extraction with their generated encoder).
+//
+// Entry points (hostpath/codec.py glue):
+//   encode(ops, coltypes, aux, addr_array, addr_schema, n, checked)
+//     -> (blob, sizes, t_extract_s, t_encode_s) | int status
+//   The fused fast path: walk the RecordBatch's validity/offset/data
+//   buffers via the Arrow C data interface (GIL released), then run the
+//   generic encode VM over the in-memory plan columns — no Python/numpy
+//   arrays exist between Arrow and the wire.
+//   extract(ops, coltypes, aux, addr_array, addr_schema, n)
+//     -> (plan buffers as list[bytes], bound) | int status
+//   The differential-test window onto the extraction pass alone.
+//
+// ``aux`` is one entry per op: None, ("uuid",), ("duration",) or
+// ("enum", symbol_bytes...) — the logical-type facts the flat opcode
+// table cannot carry (built once per codec in hostpath/codec.py).
+#include "extract_core.h"
+
+namespace {
+
+using namespace pyr;
+
+// Parsed aux tables; symbol bytes are BORROWED from the aux tuple,
+// which the caller keeps alive for the duration of the call.
+struct AuxTables {
+  std::vector<OpAux> aux;
+  std::vector<std::vector<const char*>> syms;
+  std::vector<std::vector<int32_t>> symlens;
+
+  bool parse(PyObject* aux_obj, size_t nops) {
+    aux.resize(nops);
+    syms.resize(nops);
+    symlens.resize(nops);
+    if (aux_obj == Py_None) return true;
+    if (!PyTuple_Check(aux_obj) || (size_t)PyTuple_GET_SIZE(aux_obj) != nops) {
+      PyErr_SetString(PyExc_ValueError, "aux must be a tuple of len(ops)");
+      return false;
+    }
+    for (size_t i = 0; i < nops; i++) {
+      PyObject* e = PyTuple_GET_ITEM(aux_obj, i);
+      if (e == Py_None) continue;
+      if (!PyTuple_Check(e) || PyTuple_GET_SIZE(e) < 1) {
+        PyErr_SetString(PyExc_ValueError, "bad aux entry");
+        return false;
+      }
+      PyObject* tag = PyTuple_GET_ITEM(e, 0);
+      const char* t = PyUnicode_AsUTF8(tag);
+      if (t == nullptr) return false;
+      if (std::strcmp(t, "uuid") == 0) {
+        aux[i].lane = AUX_UUID;
+      } else if (std::strcmp(t, "duration") == 0) {
+        aux[i].lane = AUX_DURATION;
+      } else if (std::strcmp(t, "enum") == 0) {
+        aux[i].lane = AUX_ENUM;
+        Py_ssize_t ns = PyTuple_GET_SIZE(e) - 1;
+        for (Py_ssize_t k = 0; k < ns; k++) {
+          PyObject* sb = PyTuple_GET_ITEM(e, (Py_ssize_t)(k + 1));
+          if (!PyBytes_Check(sb)) {
+            PyErr_SetString(PyExc_ValueError, "enum symbols must be bytes");
+            return false;
+          }
+          syms[i].push_back(PyBytes_AS_STRING(sb));
+          symlens[i].push_back((int32_t)PyBytes_GET_SIZE(sb));
+        }
+        aux[i].syms = syms[i].data();
+        aux[i].symlens = symlens[i].data();
+        aux[i].nsyms = (int32_t)syms[i].size();
+      } else {
+        PyErr_Format(PyExc_ValueError, "unknown aux tag %s", t);
+        return false;
+      }
+    }
+    return true;
+  }
+};
+
+bool parse_ops(PyObject* ops_obj, BufferGuard* guard, const Op** ops,
+               size_t* nops) {
+  if (!guard->acquire(ops_obj, "ops")) return false;
+  if (guard->view.len % sizeof(Op) != 0) {
+    PyErr_SetString(PyExc_ValueError,
+                    "ops buffer size not a multiple of op size");
+    return false;
+  }
+  *ops = static_cast<const Op*>(guard->view.buf);
+  *nops = (size_t)(guard->view.len / sizeof(Op));
+  return true;
+}
+
+PyObject* py_encode_arrow(PyObject*, PyObject* args) {
+  PyObject *ops_obj, *coltypes_obj, *aux_obj;
+  unsigned long long addr_a, addr_s;
+  Py_ssize_t n;
+  int checked = 0;
+  if (!PyArg_ParseTuple(args, "OOOKKn|i", &ops_obj, &coltypes_obj, &aux_obj,
+                        &addr_a, &addr_s, &n, &checked))
+    return nullptr;
+  BufferGuard ops_b;
+  const Op* ops;
+  size_t nops;
+  if (!parse_ops(ops_obj, &ops_b, &ops, &nops)) return nullptr;
+  AuxTables at;
+  if (!at.parse(aux_obj, nops)) return nullptr;
+  VmEncRec rec{ops};
+  return encode_arrow_boundary(rec, ops, at.aux.data(), coltypes_obj,
+                               (uintptr_t)addr_a, (uintptr_t)addr_s, n,
+                               checked);
+}
+
+PyObject* py_extract_arrow(PyObject*, PyObject* args) {
+  PyObject *ops_obj, *coltypes_obj, *aux_obj;
+  unsigned long long addr_a, addr_s;
+  Py_ssize_t n;
+  if (!PyArg_ParseTuple(args, "OOOKKn", &ops_obj, &coltypes_obj, &aux_obj,
+                        &addr_a, &addr_s, &n))
+    return nullptr;
+  BufferGuard ops_b;
+  const Op* ops;
+  size_t nops;
+  if (!parse_ops(ops_obj, &ops_b, &ops, &nops)) return nullptr;
+  AuxTables at;
+  if (!at.parse(aux_obj, nops)) return nullptr;
+  return extract_arrow_boundary(ops, at.aux.data(), coltypes_obj,
+                                (uintptr_t)addr_a, (uintptr_t)addr_s, n);
+}
+
+PyMethodDef methods[] = {
+    {"encode", py_encode_arrow, METH_VARARGS,
+     "encode(ops, coltypes, aux, addr_array, addr_schema, n, checked=0)"
+     " -> (blob, sizes, t_extract_s, t_encode_s) | status int"},
+    {"extract", py_extract_arrow, METH_VARARGS,
+     "extract(ops, coltypes, aux, addr_array, addr_schema, n)"
+     " -> (buffers, bound) | status int"},
+    {nullptr, nullptr, 0, nullptr},
+};
+
+PyModuleDef moduledef = {
+    PyModuleDef_HEAD_INIT, "_pyruhvro_extract",
+    "Arrow-native extraction + fused encode for the host tier", -1, methods,
+};
+
+}  // namespace
+
+PyMODINIT_FUNC PyInit__pyruhvro_extract(void) {
+  return PyModule_Create(&moduledef);
+}

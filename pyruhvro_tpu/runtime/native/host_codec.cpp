@@ -166,6 +166,12 @@ class Vm {
   void decode_blocks(size_t pc, Reader& r, bool is_map) {
     const Op& op = ops_[pc];
     Col& offs = (*cols_)[op.col];
+    // string fast lane: array-of-string items (and map values) skip the
+    // exec dispatch entirely — the item loop is read-len / bulk-copy
+    // against hoisted column refs (the kafka emails/phone_numbers shape)
+    bool str_items = ops_[pc + 1].kind == OP_STRING && op.nops == 2;
+    Col* item_col = str_items ? &(*cols_)[ops_[pc + 1].col] : nullptr;
+    Col* key_col = is_map ? &(*cols_)[op.b] : nullptr;
     for (;;) {
       if (r.err) return;
       int64_t count = r.read_zigzag();
@@ -176,6 +182,26 @@ class Vm {
         (void)r.read_raw_varint();  // byte size, unused
         if (r.err) return;
       }
+      if (str_items) {
+        for (int64_t i = 0; i < count; i++) {
+          if (r.err) return;
+          if (r.cur > r.end) {
+            r.err |= ERR_OVERRUN;
+            return;
+          }
+          if (is_map) {
+            rd_string(*key_col, r, true);
+            if (r.err) return;
+          }
+          rd_string(*item_col, r, true);
+          offs.running++;
+          if (offs.running < 0) {  // int32 overflow: batch too large
+            r.err |= ERR_OVERRUN;
+            return;
+          }
+        }
+        continue;
+      }
       for (int64_t i = 0; i < count; i++) {
         if (r.err) return;
         if (r.cur > r.end) {
@@ -183,7 +209,7 @@ class Vm {
           return;
         }
         if (is_map) {
-          rd_string((*cols_)[op.b], r, true);
+          rd_string(*key_col, r, true);
           if (r.err) return;
         }
         exec(pc + 1, r, true);
@@ -202,130 +228,9 @@ class Vm {
 
 // ===================== encode (Arrow → Avro wire) =====================
 //
-// Same opcode program, run in reverse: per-column entry cursors consume
-// the dense extracted arrays sequentially (row region: one entry per
-// row; item regions: entries in row order by construction of the Arrow
-// child layout), emitting wire bytes. Repeated fields emit the
-// single-block form ``[count, items…, 0]`` (≙ fast_encode.rs:518-554 —
-// wire-compatible, verified by round-trip through both decoders).
-// Absent subtrees (null branch / non-selected union arm) consume their
-// entries without emitting — the exact mirror of the decoder's
-// default-appending mode.
-
-template <class W>
-class EncVm {
- public:
-  EncVm(const Op* ops, std::vector<InCol>* cols, W* out)
-      : ops_(ops), cols_(cols), out_(out) {}
-
-  bool err = false;  // decimal didn't fit its fixed size
-
-  size_t exec(size_t pc, bool present) {
-    const Op& op = ops_[pc];
-    switch (op.kind) {
-      case OP_RECORD: {
-        size_t p = pc + 1, stop = pc + op.nops;
-        while (p < stop) p = exec(p, present);
-        return p;
-      }
-      case OP_INT:
-      case OP_ENUM: {
-        InCol& c = (*cols_)[op.col];
-        int32_t v = c.i32[c.cur++];
-        if (present) write_zigzag(*out_, (int64_t)v);
-        return pc + 1;
-      }
-      case OP_LONG: {
-        InCol& c = (*cols_)[op.col];
-        int64_t v = c.i64[c.cur++];
-        if (present) write_zigzag(*out_, v);
-        return pc + 1;
-      }
-      case OP_FLOAT: {
-        InCol& c = (*cols_)[op.col];
-        float v = c.f32[c.cur++];
-        if (present) {
-          uint8_t b[4];
-          std::memcpy(b, &v, 4);
-          out_->append(b, 4);
-        }
-        return pc + 1;
-      }
-      case OP_DOUBLE: {
-        InCol& c = (*cols_)[op.col];
-        double v = c.f64[c.cur++];
-        if (present) {
-          uint8_t b[8];
-          std::memcpy(b, &v, 8);
-          out_->append(b, 8);
-        }
-        return pc + 1;
-      }
-      case OP_BOOL: {
-        InCol& c = (*cols_)[op.col];
-        uint8_t v = c.u8[c.cur++];
-        if (present) out_->push(v ? 1 : 0);
-        return pc + 1;
-      }
-      case OP_STRING: {
-        wr_string(*out_, (*cols_)[op.col], present);
-        return pc + 1;
-      }
-      case OP_FIXED: {
-        InCol& c = (*cols_)[op.col];
-        size_t nsz = (size_t)op.a;
-        if (present)
-          out_->append(c.u8 + c.cur, nsz);
-        c.cur += nsz;
-        return pc + 1;
-      }
-      case OP_DEC_BYTES:
-      case OP_DEC_FIXED: {
-        if (!wr_decimal(*out_, (*cols_)[op.col], present,
-                        op.kind == OP_DEC_BYTES ? -1 : op.a))
-          err = true;
-        return pc + 1;
-      }
-      case OP_NULL:
-        return pc + 1;
-      case OP_NULLABLE: {
-        InCol& c = (*cols_)[op.col];
-        uint8_t valid = c.u8[c.cur++];
-        if (present)
-          write_zigzag(*out_, valid ? (int64_t)(1 - op.a) : (int64_t)op.a);
-        return exec(pc + 1, present && valid);
-      }
-      case OP_UNION: {
-        InCol& c = (*cols_)[op.col];
-        int32_t tid = c.i32[c.cur++];
-        if (present) write_zigzag(*out_, (int64_t)tid);
-        size_t p = pc + 1;
-        for (int32_t k = 0; k < op.a; k++)
-          p = exec(p, present && k == tid);
-        return p;
-      }
-      case OP_ARRAY:
-      case OP_MAP: {
-        InCol& c = (*cols_)[op.col];
-        int32_t count = c.i32[c.cur++];
-        bool is_map = op.kind == OP_MAP;
-        if (present && count > 0) write_zigzag(*out_, (int64_t)count);
-        for (int32_t i = 0; i < count; i++) {
-          if (is_map) wr_string(*out_, (*cols_)[op.b], present);
-          exec(pc + 1, present);
-        }
-        if (present) out_->push(0);  // block terminator
-        return pc + 1 + ops_[pc + 1].nops;
-      }
-    }
-    return pc + 1;  // unreachable for well-formed programs
-  }
-
- private:
-  const Op* ops_;
-  std::vector<InCol>* cols_;
-  W* out_;
-};
+// The generic encode VM (EncVm) and its per-record functor (VmEncRec)
+// live in host_vm_core.h, shared with the Arrow-native fused encode
+// boundary in extract.cpp.
 
 // ---- Python boundary -------------------------------------------------
 
@@ -383,16 +288,6 @@ PyObject* py_decode(PyObject*, PyObject* args) {
 // through the shared boundary (host_vm_core.h) with a VM-backed
 // per-record encoder. Schema-specialized modules provide the same
 // ``encode`` without the ops argument.
-struct VmEncRec {
-  const Op* ops;
-  template <class W>
-  bool operator()(W& w, std::vector<InCol>& cols) const {
-    EncVm<W> vm(ops, &cols, &w);
-    vm.exec(0, true);
-    return !vm.err;
-  }
-};
-
 PyObject* py_encode(PyObject*, PyObject* args) {
   PyObject *ops_obj, *coltypes_obj, *bufs_obj;
   Py_ssize_t n;

@@ -179,21 +179,45 @@ struct Reader {
         cur++;
         return b0;
       }
-      if (end - cur >= 10) {  // full wire max in-span: no per-byte bounds
-        const uint8_t* p = base + cur;
-        uint64_t v = b0 & 0x7F;
-        int shift = 7;
-        for (int k = 1; k < 10; k++) {
-          uint8_t byte = p[k];
-          v |= (uint64_t)(byte & 0x7F) << shift;
-          if (byte < 0x80) {
-            cur += k + 1;
-            return v;
-          }
-          shift += 7;
+      if (end - cur >= 8) {
+        // SFVInt-style multi-byte peel (arxiv 2403.06898): load 8 wire
+        // bytes at once, find the terminator byte with one ctz over the
+        // continuation-bit lane, then compact the 7-bit groups with the
+        // classic 3-step pairwise fold — no loop-carried per-byte
+        // dependency for every varint up to 56 bits (all lengths,
+        // counts, ints and all but astronomically large longs)
+        uint64_t w;
+        std::memcpy(&w, base + cur, 8);
+        uint64_t stops = ~w & 0x8080808080808080ULL;
+        if (stops) {
+          int nb = (__builtin_ctzll(stops) >> 3) + 1;  // 1..8 bytes
+          cur += nb;
+          if (nb < 8) w &= (1ULL << (nb * 8)) - 1;
+          w &= 0x7F7F7F7F7F7F7F7FULL;
+          w = (w & 0x007F007F007F007FULL) |
+              ((w & 0x7F007F007F007F00ULL) >> 1);
+          w = (w & 0x00003FFF00003FFFULL) |
+              ((w & 0x3FFF00003FFF0000ULL) >> 2);
+          w = (w & 0x000000000FFFFFFFULL) |
+              ((w & 0x0FFFFFFF00000000ULL) >> 4);
+          return w;
         }
-        err |= ERR_VARINT;
-        return 0;
+        if (end - cur >= 10) {  // 9-10 wire bytes in-span: rare giants
+          const uint8_t* p = base + cur;
+          uint64_t v = b0 & 0x7F;
+          int shift = 7;
+          for (int k = 1; k < 10; k++) {
+            uint8_t byte = p[k];
+            v |= (uint64_t)(byte & 0x7F) << shift;
+            if (byte < 0x80) {
+              cur += k + 1;
+              return v;
+            }
+            shift += 7;
+          }
+          err |= ERR_VARINT;
+          return 0;
+        }
       }
     }
     // tail path: per-byte bounds near the record end
@@ -811,6 +835,140 @@ inline bool wr_decimal(W& out, InCol& c, bool present, int64_t fixed_size) {
   }
   return true;
 }
+
+// The generic bytecode encode VM: the opcode program run in reverse —
+// per-column entry cursors consume the dense extracted arrays
+// sequentially, emitting wire bytes. Lives in the shared core (not
+// host_codec.cpp) so the Arrow-native extractor module can run the
+// same interpreter fused behind its extraction pass. Absent subtrees
+// (null branch / non-selected union arm) consume their entries without
+// emitting — the exact mirror of the decoder's default-appending mode.
+template <class W>
+class EncVm {
+ public:
+  EncVm(const Op* ops, std::vector<InCol>* cols, W* out)
+      : ops_(ops), cols_(cols), out_(out) {}
+
+  bool err = false;  // decimal didn't fit its fixed size
+
+  size_t exec(size_t pc, bool present) {
+    const Op& op = ops_[pc];
+    switch (op.kind) {
+      case OP_RECORD: {
+        size_t p = pc + 1, stop = pc + op.nops;
+        while (p < stop) p = exec(p, present);
+        return p;
+      }
+      case OP_INT:
+      case OP_ENUM: {
+        InCol& c = (*cols_)[op.col];
+        int32_t v = c.i32[c.cur++];
+        if (present) write_zigzag(*out_, (int64_t)v);
+        return pc + 1;
+      }
+      case OP_LONG: {
+        InCol& c = (*cols_)[op.col];
+        int64_t v = c.i64[c.cur++];
+        if (present) write_zigzag(*out_, v);
+        return pc + 1;
+      }
+      case OP_FLOAT: {
+        InCol& c = (*cols_)[op.col];
+        float v = c.f32[c.cur++];
+        if (present) {
+          uint8_t b[4];
+          std::memcpy(b, &v, 4);
+          out_->append(b, 4);
+        }
+        return pc + 1;
+      }
+      case OP_DOUBLE: {
+        InCol& c = (*cols_)[op.col];
+        double v = c.f64[c.cur++];
+        if (present) {
+          uint8_t b[8];
+          std::memcpy(b, &v, 8);
+          out_->append(b, 8);
+        }
+        return pc + 1;
+      }
+      case OP_BOOL: {
+        InCol& c = (*cols_)[op.col];
+        uint8_t v = c.u8[c.cur++];
+        if (present) out_->push(v ? 1 : 0);
+        return pc + 1;
+      }
+      case OP_STRING: {
+        wr_string(*out_, (*cols_)[op.col], present);
+        return pc + 1;
+      }
+      case OP_FIXED: {
+        InCol& c = (*cols_)[op.col];
+        size_t nsz = (size_t)op.a;
+        if (present) out_->append(c.u8 + c.cur, nsz);
+        c.cur += nsz;
+        return pc + 1;
+      }
+      case OP_DEC_BYTES:
+      case OP_DEC_FIXED: {
+        if (!wr_decimal(*out_, (*cols_)[op.col], present,
+                        op.kind == OP_DEC_BYTES ? -1 : op.a))
+          err = true;
+        return pc + 1;
+      }
+      case OP_NULL:
+        return pc + 1;
+      case OP_NULLABLE: {
+        InCol& c = (*cols_)[op.col];
+        uint8_t valid = c.u8[c.cur++];
+        if (present)
+          write_zigzag(*out_, valid ? (int64_t)(1 - op.a) : (int64_t)op.a);
+        return exec(pc + 1, present && valid);
+      }
+      case OP_UNION: {
+        InCol& c = (*cols_)[op.col];
+        int32_t tid = c.i32[c.cur++];
+        if (present) write_zigzag(*out_, (int64_t)tid);
+        size_t p = pc + 1;
+        for (int32_t k = 0; k < op.a; k++)
+          p = exec(p, present && k == tid);
+        return p;
+      }
+      case OP_ARRAY:
+      case OP_MAP: {
+        InCol& c = (*cols_)[op.col];
+        int32_t count = c.i32[c.cur++];
+        bool is_map = op.kind == OP_MAP;
+        if (present && count > 0) write_zigzag(*out_, (int64_t)count);
+        for (int32_t i = 0; i < count; i++) {
+          if (is_map) wr_string(*out_, (*cols_)[op.b], present);
+          exec(pc + 1, present);
+        }
+        if (present) out_->push(0);  // block terminator
+        return pc + 1 + ops_[pc + 1].nops;
+      }
+    }
+    return pc + 1;  // unreachable for well-formed programs
+  }
+
+ private:
+  const Op* ops_;
+  std::vector<InCol>* cols_;
+  W* out_;
+};
+
+// The VM-backed per-record encoder functor shared by the generic
+// boundary (host_codec.cpp py_encode) and the Arrow-native fused
+// boundary (extract.cpp): encodes ONE record, false on decimal misfit.
+struct VmEncRec {
+  const Op* ops;
+  template <class W>
+  bool operator()(W& w, std::vector<InCol>& cols) const {
+    EncVm<W> vm(ops, &cols, &w);
+    vm.exec(0, true);
+    return !vm.err;
+  }
+};
 
 // The per-record encode loop, generic over BOTH the writer strategy and
 // the per-record encoder. ``Rec`` is a functor with

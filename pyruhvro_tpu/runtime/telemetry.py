@@ -33,6 +33,14 @@ phase-breakdown table from a saved snapshot or a ``BENCH_DETAILS.json``
 Naming convention (same as :mod:`.metrics`): keys are
 ``component.event``; keys ending ``_s`` are seconds and get histograms,
 everything else is a plain count/byte counter.
+
+Host-tier serialize keys (ISSUE 2): the fused Arrow-native encode
+reports its split as ``host.extract_native_s`` (the C++ extraction
+walk; also folded into ``host.extract_s`` so the extract-vs-encode
+comparison stays one key pair) and ``host.encode_vm_s``; per-call
+counters ``extract.native`` vs ``extract.fallback`` (split into
+``extract.fallback_shape`` / ``extract.fallback_data``) say which
+extractor served each call.
 """
 
 from __future__ import annotations

@@ -26,5 +26,12 @@ setup(
             extra_compile_args=["-O3", "-std=c++17", "-pthread"],
             optional=True,
         ),
+        Extension(
+            "pyruhvro_tpu.runtime.native._pyruhvro_extract",
+            sources=["pyruhvro_tpu/runtime/native/extract.cpp"],
+            language="c++",
+            extra_compile_args=["-O3", "-std=c++17", "-pthread"],
+            optional=True,
+        ),
     ],
 )

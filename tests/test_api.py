@@ -65,6 +65,30 @@ def test_deserialize_array_threaded_chunked_shape(backend):
 
 
 @pytest.mark.parametrize("backend", ["host", "auto"])
+def test_deserialize_threaded_nested_union_chunks(backend):
+    """Sliced chunks must render unions correctly even when the union
+    sits INSIDE a struct column (the slice offset lives on the struct;
+    pyarrow's sparse-union scalar access mis-reads through it —
+    compact_union_slices must compact union-BEARING columns, not only
+    top-level union columns)."""
+    schema = json.dumps({
+        "type": "record", "name": "N",
+        "fields": [{"name": "s", "type": {
+            "type": "record", "name": "S",
+            "fields": [{"name": "inner",
+                        "type": ["null", "string", "int"]}]}}],
+    })
+    from pyruhvro_tpu.utils.datagen import random_datums
+
+    datums = random_datums(pv.parse_schema(schema), 10, seed=4)
+    batches = pv.deserialize_array_threaded(datums, schema, 3,
+                                            backend=backend)
+    merged = pa.Table.from_batches(batches)
+    whole = pv.deserialize_array(datums, schema, backend=backend)
+    assert merged.to_pylist() == pa.Table.from_batches([whole]).to_pylist()
+
+
+@pytest.mark.parametrize("backend", ["host", "auto"])
 def test_serialize_round_trip(backend):
     datums = kafka_style_datums(20, seed=3)
     batch = pv.deserialize_array(datums, KAFKA_SCHEMA_JSON, backend=backend)
