@@ -218,7 +218,22 @@ def _run_case(op, schema, datums, backend, chunks, reps, details,
             f"d2h={snap.get(mkey + '.d2h_bytes', 0) / 1e6:.2f}MB"
             if backend == "tpu" else ""))
     last_span = tsnap["spans"][-1] if tsnap["spans"] else None
+    # native-profiler decomposition (only non-empty when the run was
+    # started with PYRUHVRO_TPU_NATIVE_PROF=1): how much of the VM phase
+    # the per-opcode self-times account for
+    vm_op_s = sum(v for k, v in snap.items()
+                  if k.startswith("vm.op.") and k.endswith("_s"))
+    native_prof = None
+    if vm_op_s and snap.get("host.vm_s"):
+        native_prof = {
+            "vm_op_s": round(vm_op_s, 6),
+            "coverage_of_vm": round(vm_op_s / snap["host.vm_s"], 4),
+        }
+        _log(f"[bench] native profiler: vm.op.* self time "
+             f"{vm_op_s * 1e3:.3f} ms = "
+             f"{native_prof['coverage_of_vm'] * 100:.1f}% of host.vm_s")
     details["results"].append({
+        **({"native_prof": native_prof} if native_prof else {}),
         "op": op, "backend": backend, "rows": rows, "chunks": chunks,
         "schema": label or "kafka", "seconds": dt, "records_per_s": rec_s,
         "vs_baseline": rec_s / base,

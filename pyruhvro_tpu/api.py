@@ -41,8 +41,8 @@ from .ops import UnsupportedOnDevice
 from .fallback.decoder import compile_reader, decode_to_record_batch
 from .fallback.encoder import compile_encoder_plan, encode_record_batch
 from .runtime import metrics, telemetry
-from .runtime.chunking import chunk_bounds
-from .runtime.pool import map_chunks
+from .runtime.chunking import bounds_rows, chunk_bounds
+from .runtime.pool import map_chunks, map_chunks_proc, pool_mode
 from .schema.cache import SchemaEntry, get_or_parse_schema
 
 __all__ = [
@@ -256,6 +256,46 @@ def _check_backend(backend: str) -> str:
     return backend
 
 
+# -- opt-in process-pool chunk fan-out (PYRUHVRO_TPU_POOL=process) ---------
+#
+# Host-tier chunked calls can fan chunks to a spawn-based process pool:
+# each worker re-enters the public API for its slice (schema parse +
+# native codec are per-process caches, warm after the first chunk) under
+# a ``telemetry.worker_scope`` and ships its counter deltas + span tree
+# back with the result, which ``map_chunks_proc`` merges — the parent's
+# snapshot covers every worker's phases and rows, nothing is dropped on
+# the process boundary. The device tier never fans out this way (its
+# chunk axis is the device mesh, not host processes).
+
+
+def _proc_decode_task(payload):
+    schema, data = payload
+    with telemetry.worker_scope("pool.worker", rows=len(data),
+                                op="decode") as w:
+        batch = deserialize_array(data, schema, backend="host")
+    return batch, w.payload
+
+
+def _proc_encode_task(payload):
+    schema, batch = payload
+    with telemetry.worker_scope("pool.worker", rows=batch.num_rows,
+                                op="encode") as w:
+        [arr] = serialize_record_batch(batch, schema, 1, backend="host")
+    return arr, w.payload
+
+
+def _proc_map(task, payloads, rows):
+    """Fan out on the process pool; None = fall back to the thread path
+    (counted): a pool failure must degrade, never fail the call. A
+    worker's own decode/encode error re-raises from the thread retry
+    with its exact message."""
+    try:
+        return map_chunks_proc(task, payloads, rows=rows)
+    except Exception:
+        metrics.inc("pool.process_fallback")
+        return None
+
+
 def deserialize_array(
     data: Sequence[bytes], schema: str, *, backend: str = "auto"
 ) -> pa.RecordBatch:
@@ -294,6 +334,14 @@ def deserialize_array_threaded(
                              backend=backend, schema=entry.fingerprint):
         tier, impl, reason = _route(entry, backend, len(data))
         telemetry.set_route(tier, reason)
+        if tier != "device" and len(bounds) > 1 and pool_mode() == "process":
+            out = _proc_map(
+                _proc_decode_task,
+                [(schema, list(data[a:b])) for a, b in bounds],
+                rows=lambda p: len(p[1]),
+            )
+            if out is not None:
+                return out
         if tier != "fallback":
             return impl.decode_threaded(data, num_chunks)
         ir, arrow, reader = entry.ir, entry.arrow_schema, _host_reader(entry)
@@ -304,7 +352,7 @@ def deserialize_array_threaded(
                     data[ab[0]:ab[1]], ir, arrow, reader
                 )
 
-        return map_chunks(decode_chunk, bounds)
+        return map_chunks(decode_chunk, bounds, rows=bounds_rows)
 
 
 def deserialize_array_threaded_spawn(
@@ -336,6 +384,14 @@ def serialize_record_batch(
         tier, impl, reason = _route(entry, backend, batch.num_rows,
                                     need_encode=True)
         telemetry.set_route(tier, reason)
+        if tier != "device" and len(bounds) > 1 and pool_mode() == "process":
+            out = _proc_map(
+                _proc_encode_task,
+                [(schema, batch.slice(a, b - a)) for a, b in bounds],
+                rows=lambda p: p[1].num_rows,
+            )
+            if out is not None:
+                return out
         if tier != "fallback":
             return impl.encode_threaded(batch, num_chunks)
         ir = entry.ir
@@ -350,7 +406,7 @@ def serialize_record_batch(
                 )
                 return pa.array(datums, pa.binary())
 
-        return map_chunks(encode_chunk, bounds)
+        return map_chunks(encode_chunk, bounds, rows=bounds_rows)
 
 
 def serialize_record_batch_spawn(

@@ -32,6 +32,43 @@ def native_available() -> bool:
     return load_host_codec() is not None
 
 
+def _drain_native_prof(*mods) -> None:
+    """Fold the native-tier profiler's per-opcode counters into the
+    telemetry layer (``vm.op.*`` / ``vm.encop.*`` / ``extract.op.*``
+    hit counts plus ``*_s`` self-time seconds). No-op on the default
+    (unprofiled) builds — only the PYRUHVRO_TPU_NATIVE_PROF=1 variants
+    export ``prof_drain``."""
+    from ..runtime import metrics
+
+    for mod in mods:
+        drain = getattr(mod, "prof_drain", None)
+        if drain is None:
+            continue
+        for key, (hits, ns) in drain().items():
+            if hits:
+                metrics.inc(key, float(hits))
+            if ns:
+                metrics.inc(key + "_s", ns * 1e-9)
+
+
+def _vm_threads(nthreads: int) -> int:
+    """Resolve the VM shard-thread count: an explicit argument wins,
+    else PYRUHVRO_TPU_VM_THREADS pins it (profiling runs set 1 so the
+    per-opcode self-times decompose the wall-clock ``host.vm_s`` instead
+    of summing CPU time across shards), else 0 = the VM's auto pick."""
+    if nthreads:
+        return nthreads
+    import os
+
+    env = os.environ.get("PYRUHVRO_TPU_VM_THREADS")
+    if env:
+        try:
+            return max(0, int(env))
+        except ValueError:
+            pass
+    return 0
+
+
 class NativeHostCodec:
     """Schema-bound native decoder (per-schema program, compiled once).
 
@@ -61,7 +98,13 @@ class NativeHostCodec:
         import os
 
         self._spec = None            # the specialized module, once built
-        self._spec_failed = os.environ.get("PYRUHVRO_TPU_NO_SPECIALIZE") == "1"
+        # the per-opcode profiler lives in the generic VM's dispatch
+        # points; the specialized engines are straight-line code with
+        # nothing to attribute, so profiling pins the interpreter
+        self._prof = os.environ.get("PYRUHVRO_TPU_NATIVE_PROF") == "1"
+        self._spec_failed = (
+            os.environ.get("PYRUHVRO_TPU_NO_SPECIALIZE") == "1" or self._prof
+        )
         try:
             self._spec_rows = int(os.environ.get(
                 "PYRUHVRO_TPU_SPECIALIZE_ROWS", self._SPECIALIZE_ROWS))
@@ -116,8 +159,11 @@ class NativeHostCodec:
                     )
                 else:
                     bufs, err_rec, err_bits = self._mod.decode(
-                        self.prog.ops, self.prog.coltypes, data, nthreads
+                        self.prog.ops, self.prog.coltypes, data,
+                        _vm_threads(nthreads)
                     )
+            if self._prof:
+                _drain_native_prof(self._mod)
             if err_rec >= 0:
                 bit = err_bits & -err_bits
                 raise MalformedAvro(
@@ -258,6 +304,8 @@ class NativeHostCodec:
             metrics.inc("extract.fallback")
             metrics.inc("extract.fallback_stale")
             return None
+        if self._prof and mod is not None:
+            _drain_native_prof(mod)
         if isinstance(res, int):
             # 1 = arrow shape outside the native surface; 2 = a data
             # error the Python extractor reports with its exact message
@@ -386,6 +434,8 @@ class NativeHostCodec:
                 raise  # oracle parity (int.to_bytes overflow) — a
                 # batch split cannot make the value fit
             raise BatchTooLarge(n, -1)
+        if self._prof:
+            _drain_native_prof(self._mod)
         return self._wrap_blob(blob, sizes, n)
 
     def encode_threaded(self, batch: pa.RecordBatch,
@@ -405,6 +455,7 @@ class NativeHostCodec:
             # releases the GIL for essentially the whole call, so chunk
             # encodes genuinely overlap on multi-core hosts (the encode
             # analogue of the decode VM's internal row sharding)
+            from ..runtime.chunking import bounds_rows
             from ..runtime.pool import map_chunks
 
             return map_chunks(
@@ -412,6 +463,7 @@ class NativeHostCodec:
                     batch.slice(ab[0], ab[1] - ab[0])
                 ),
                 bounds,
+                rows=bounds_rows,
             )
         arr = self._encode_split(batch)
         return [arr.slice(a, b - a) for a, b in bounds]

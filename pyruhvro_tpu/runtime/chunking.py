@@ -12,7 +12,13 @@ from __future__ import annotations
 
 from typing import List, Sequence, Tuple
 
-__all__ = ["clamp_chunks", "chunk_bounds", "chunk_slices"]
+__all__ = ["clamp_chunks", "chunk_bounds", "chunk_slices", "bounds_rows"]
+
+
+def bounds_rows(ab: Tuple[int, int]) -> int:
+    """Row count of one (start, stop) chunk bound — the pool's per-chunk
+    attribution hook (chunk-span ``rows`` + ``pool.worker_rows``)."""
+    return ab[1] - ab[0]
 
 
 def clamp_chunks(num_chunks: int, data_len: int) -> int:

@@ -20,15 +20,55 @@ import time
 from collections import defaultdict
 from typing import Dict
 
-__all__ = ["inc", "snapshot", "reset", "timer"]
+__all__ = ["inc", "merge", "snapshot", "reset", "timer", "record_deltas"]
 
 _lock = threading.Lock()
 _counters: Dict[str, float] = defaultdict(float)
+_tls = threading.local()
 
 
 def inc(key: str, value: float = 1.0) -> None:
     with _lock:
         _counters[key] += value
+    rec = getattr(_tls, "delta", None)
+    if rec is not None:
+        rec[key] = rec.get(key, 0.0) + value
+
+
+def merge(deltas: Dict[str, float]) -> None:
+    """Fold a counter-delta dict (a pool/process worker's exported
+    increments) into this process's counters in one lock acquisition."""
+    with _lock:
+        for k, v in deltas.items():
+            _counters[k] += v
+    rec = getattr(_tls, "delta", None)
+    if rec is not None:
+        for k, v in deltas.items():
+            rec[k] = rec.get(k, 0.0) + v
+
+
+class record_deltas:
+    """Record every ``inc`` made on THIS thread into a plain dict —
+    the per-worker attribution primitive behind
+    :func:`..telemetry.worker_scope` and the pool's per-chunk
+    accounting. Nesting is additive: an inner recorder's deltas fold
+    into the enclosing one on exit, so a worker-scope wrapped around
+    chunk-scopes still sees the full total."""
+
+    __slots__ = ("delta", "_prev")
+
+    def __enter__(self) -> Dict[str, float]:
+        self._prev = getattr(_tls, "delta", None)
+        self.delta = {}
+        _tls.delta = self.delta
+        return self.delta
+
+    def __exit__(self, *exc):
+        _tls.delta = self._prev
+        if self._prev is not None:
+            for k, v in self.delta.items():
+                self._prev[k] = self._prev.get(k, 0.0) + v
+        return False
 
 
 def snapshot() -> Dict[str, float]:

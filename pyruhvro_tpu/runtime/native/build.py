@@ -28,6 +28,14 @@ def _so_path(mod_name: str) -> str:
     return os.path.join(_HERE, mod_name + _ext_suffix())
 
 
+def _prof_active() -> bool:
+    """PYRUHVRO_TPU_NATIVE_PROF=1 selects the per-opcode-profiled build
+    of the host codec + extractor (a separate cached .so compiled with
+    -DPYRUHVRO_NATIVE_PROF; the default build carries zero profiling
+    code). Read per load so tests can toggle it."""
+    return os.environ.get("PYRUHVRO_TPU_NATIVE_PROF") == "1"
+
+
 def _cpu_tag() -> str:
     """A stable fingerprint of this host's ISA surface. Guards the
     ``-march=native`` build cache: a .so baked on one machine (container
@@ -69,11 +77,12 @@ def _needs_build(so: str, src: str) -> bool:
         return False
 
 
-def _compile(so: str, src: str) -> None:
+def _compile(so: str, src: str, extra_flags=()) -> None:
     include = sysconfig.get_paths()["include"]
     tmp = f"{so}.{os.getpid()}.tmp"  # per-process: concurrent builds can't clobber
     base = [
         "g++", "-O3", "-shared", "-fPIC", "-std=c++17", "-pthread",
+        *extra_flags,
         "-I", include, src, "-o", tmp,
     ]
     try:
@@ -98,20 +107,25 @@ def _compile(so: str, src: str) -> None:
             os.unlink(tmp)
 
 
-def _load(mod_name: str, src_file: str):
+def _load(mod_name: str, src_file: str, prof: bool = False):
     """Compile-if-stale and import one extension module (memoized;
-    None is memoized too so a broken toolchain is probed once)."""
-    if mod_name in _modules:
-        return _modules[mod_name]
+    None is memoized too so a broken toolchain is probed once).
+    ``prof=True`` builds/loads the profiled variant to a distinct cached
+    file (``<mod>.prof<EXT_SUFFIX>``); both variants export the same
+    module name, so either satisfies the PyInit lookup."""
+    key = mod_name + ("@prof" if prof else "")
+    if key in _modules:
+        return _modules[key]
     with _lock:
-        if mod_name in _modules:
-            return _modules[mod_name]
-        so = _so_path(mod_name)
+        if key in _modules:
+            return _modules[key]
+        so = _so_path(mod_name + (".prof" if prof else ""))
         src = os.path.join(_HERE, src_file)
+        flags = ("-DPYRUHVRO_NATIVE_PROF=1",) if prof else ()
         try:
             if _needs_build(so, src):
                 try:
-                    _compile(so, src)
+                    _compile(so, src, flags)
                 except Exception as e:
                     # a wheel-built .so in a read-only site-packages can
                     # trip the mtime check (install order) yet be
@@ -131,10 +145,10 @@ def _load(mod_name: str, src_file: str):
             spec = importlib.util.spec_from_file_location(mod_name, so)
             mod = importlib.util.module_from_spec(spec)
             spec.loader.exec_module(mod)
-            _modules[mod_name] = mod
+            _modules[key] = mod
         except Exception:
-            _modules[mod_name] = None
-        return _modules[mod_name]
+            _modules[key] = None
+        return _modules[key]
 
 
 def loaded_host_codec_with(symbol: str):
@@ -142,9 +156,15 @@ def loaded_host_codec_with(symbol: str):
     ``symbol`` — the shared predicate for optional native fast paths
     (assembler, extractor). Never triggers a JIT build, so hot paths
     can call it freely; a stale .so without the symbol makes the guard
-    site and the dispatch site fall back together."""
-    mod = _modules.get("_pyruhvro_hostcodec")
-    return mod if mod is not None and hasattr(mod, symbol) else None
+    site and the dispatch site fall back together. Prefers the profiled
+    variant when PYRUHVRO_TPU_NATIVE_PROF selects it."""
+    keys = ("_pyruhvro_hostcodec@prof", "_pyruhvro_hostcodec") \
+        if _prof_active() else ("_pyruhvro_hostcodec",)
+    for key in keys:
+        mod = _modules.get(key)
+        if mod is not None and hasattr(mod, symbol):
+            return mod
+    return None
 
 
 def load_native():
@@ -152,12 +172,40 @@ def load_native():
     return _load("_pyruhvro_native", "packer.cpp")
 
 
+_prof_fallback_warned: set = set()
+
+
+def _load_maybe_prof(mod_name: str, src_file: str):
+    """Prof variant when requested, falling back to the plain build when
+    the prof JIT cannot be produced (wheel in a read-only site-packages,
+    no g++): enabling the profiler must never silently demote the whole
+    native tier to the pure-Python fallback."""
+    if _prof_active():
+        mod = _load(mod_name, src_file, prof=True)
+        if mod is not None:
+            return mod
+        if mod_name not in _prof_fallback_warned:
+            _prof_fallback_warned.add(mod_name)
+            import warnings
+
+            warnings.warn(
+                f"pyruhvro_tpu: PYRUHVRO_TPU_NATIVE_PROF=1 but the "
+                f"profiled {src_file} build is unavailable; using the "
+                f"unprofiled native module (no vm.op.* keys)",
+                RuntimeWarning,
+            )
+    return _load(mod_name, src_file)
+
+
 def load_host_codec():
-    """The host decode/encode VM, or None if the toolchain is missing."""
-    return _load("_pyruhvro_hostcodec", "host_codec.cpp")
+    """The host decode/encode VM, or None if the toolchain is missing.
+    Under PYRUHVRO_TPU_NATIVE_PROF=1 this is the per-opcode-profiled
+    build (separate cached binary, same module surface + prof_drain),
+    degrading to the plain build when the prof JIT is unavailable."""
+    return _load_maybe_prof("_pyruhvro_hostcodec", "host_codec.cpp")
 
 
 def load_extract():
     """The Arrow-native extractor / fused encoder, or None if the
     toolchain is missing (callers keep the Python extractor)."""
-    return _load("_pyruhvro_extract", "extract.cpp")
+    return _load_maybe_prof("_pyruhvro_extract", "extract.cpp")

@@ -129,7 +129,18 @@ PyObject* py_extract_arrow(PyObject*, PyObject* args) {
                                 (uintptr_t)addr_a, (uintptr_t)addr_s, n);
 }
 
+#ifdef PYRUHVRO_NATIVE_PROF
+// prof_drain() -> {"extract.op.<name>" | "vm.encop.<name>": (hits, ns)};
+// this module's own counters (each extension compiles its own copy of
+// the prof globals), drained by hostpath/codec.py after fused calls
+PyObject* py_prof_drain(PyObject*, PyObject*) { return prof::drain_py(); }
+#endif
+
 PyMethodDef methods[] = {
+#ifdef PYRUHVRO_NATIVE_PROF
+    {"prof_drain", py_prof_drain, METH_NOARGS,
+     "prof_drain() -> {telemetry_key: (hits, ns)} (clears the counters)"},
+#endif
     {"encode", py_encode_arrow, METH_VARARGS,
      "encode(ops, coltypes, aux, addr_array, addr_schema, n, checked=0)"
      " -> (blob, sizes, t_extract_s, t_encode_s) | status int"},

@@ -174,6 +174,7 @@ class ArrowExtractor {
   size_t walk(size_t pc, AView v, const uint8_t* parent) {
     const Op& op = ops_[pc];
     if (status != EXTRACT_OK) return pc + op.nops;
+    PYR_PROF_OP(pyr::prof::DOM_EXT, op.kind);
     const char* f = v.s->format;
     switch (op.kind) {
       case OP_NULLABLE: {
@@ -699,6 +700,7 @@ inline PyObject* encode_arrow_boundary(Rec rec, const Op* ops,
   Py_BEGIN_ALLOW_THREADS;
   auto t0 = std::chrono::steady_clock::now();
   ex.walk(0, root, nullptr);
+  PYR_PROF_FLUSH();
   t_extract = std::chrono::duration<double>(
                   std::chrono::steady_clock::now() - t0)
                   .count();
@@ -827,6 +829,7 @@ inline PyObject* extract_arrow_boundary(const Op* ops, const OpAux* aux,
   AView root{&owner.arr, &owner.sch, owner.arr.offset, owner.arr.length};
   Py_BEGIN_ALLOW_THREADS;
   ex.walk(0, root, nullptr);
+  PYR_PROF_FLUSH();
   Py_END_ALLOW_THREADS;
   if (ex.status != EXTRACT_OK) return PyLong_FromLong(ex.status);
   PyObject* bufs = PyList_New(0);

@@ -44,6 +44,7 @@ class Vm {
   // appends defaults without consuming wire bytes (null/absent branch).
   size_t exec(size_t pc, Reader& r, bool present) {
     const Op& op = ops_[pc];
+    PYR_PROF_OP(pyr::prof::DOM_VM, op.kind);
     switch (op.kind) {
       case OP_RECORD: {
         size_t p = pc + 1, stop = pc + op.nops;
@@ -189,6 +190,9 @@ class Vm {
             r.err |= ERR_OVERRUN;
             return;
           }
+          // the fast lane skips exec dispatch; attribute its item work
+          // to the string opcode so the profiler still sees the loop
+          PYR_PROF_OP(pyr::prof::DOM_VM, OP_STRING);
           if (is_map) {
             rd_string(*key_col, r, true);
             if (r.err) return;
@@ -499,10 +503,20 @@ PyObject* py_uuid_text(PyObject*, PyObject* args) {
   return out;
 }
 
+#ifdef PYRUHVRO_NATIVE_PROF
+// prof_drain() -> {"vm.op.<name>": (hits, ns), ...}; snapshot-and-clear
+// of the per-opcode profiler counters (present only in the prof build)
+PyObject* py_prof_drain(PyObject*, PyObject*) { return prof::drain_py(); }
+#endif
+
 PyMethodDef methods[] = {
     {"decode", py_decode, METH_VARARGS,
      "decode(ops, coltypes, flat, offsets, n, nthreads=0) -> "
      "(buffers | None, err_record, err_bits)"},
+#ifdef PYRUHVRO_NATIVE_PROF
+    {"prof_drain", py_prof_drain, METH_NOARGS,
+     "prof_drain() -> {telemetry_key: (hits, ns)} (clears the counters)"},
+#endif
     {"encode", py_encode, METH_VARARGS,
      "encode(ops, coltypes, buffers, n, size_hint=0) -> "
      "(blob, sizes_int32)"},

@@ -28,7 +28,131 @@
 #include <utility>
 #include <vector>
 
+#ifdef PYRUHVRO_NATIVE_PROF
+#include <atomic>
+#include <chrono>
+#include <cstdio>
+#endif
+
 namespace pyr {
+
+// ---- native-tier profiler (compiled in only under -DPYRUHVRO_NATIVE_PROF,
+// selected at JIT-build time by PYRUHVRO_TPU_NATIVE_PROF=1) --------------
+//
+// Per-opcode hit/time counters with WATERMARK attribution: every dispatch
+// point stamps the clock and charges the elapsed interval to the opcode
+// that was executing, so the per-op times are self-times that sum to the
+// instrumented region's wall clock — no double counting across the
+// recursive exec() tree. Two pseudo-slots cover the decode boundary's
+// non-dispatch work (span collection under the GIL, shard-buffer merge)
+// so the sum decomposes ~all of host.vm_s, not just the exec loop.
+//
+// Worker threads accumulate in a thread_local block and publish to the
+// process-wide atomics when their shard ends (run_shard_t), so the
+// multi-threaded VM needs no locks on the hot path. ``prof_drain_py``
+// (GIL held) snapshots-and-clears the atomics into a dict keyed by the
+// telemetry names Python feeds straight into metrics.inc:
+// ``vm.op.<name>`` (decode VM), ``vm.encop.<name>`` (encode VM),
+// ``extract.op.<name>`` (Arrow-native extraction walk).
+#ifdef PYRUHVRO_NATIVE_PROF
+namespace prof {
+
+enum Domain : int { DOM_VM = 0, DOM_ENC = 1, DOM_EXT = 2, N_DOM = 3 };
+// slots 0..15 mirror OpKind; 16/17 are the boundary pseudo-ops
+enum : int { P_COLLECT = 16, P_MERGE = 17, N_SLOT = 18 };
+
+inline const char* const kSlotName[N_SLOT] = {
+    "record", "int",  "long",     "float", "double",    "bool",
+    "string", "enum", "null",     "nullable", "union",  "array",
+    "map",    "fixed", "dec_bytes", "dec_fixed", "collect", "merge",
+};
+inline const char* const kDomPrefix[N_DOM] = {"vm.op.", "vm.encop.",
+                                              "extract.op."};
+
+inline std::atomic<unsigned long long> g_hits[N_DOM][N_SLOT];
+inline std::atomic<unsigned long long> g_ns[N_DOM][N_SLOT];
+
+struct Tls {
+  unsigned long long hits[N_DOM][N_SLOT] = {};
+  unsigned long long ns[N_DOM][N_SLOT] = {};
+  int dom = 0;
+  int slot = -1;  // -1 = no open attribution interval
+  unsigned long long last = 0;
+};
+inline thread_local Tls t;
+
+inline unsigned long long now_ns() {
+  return (unsigned long long)std::chrono::duration_cast<
+             std::chrono::nanoseconds>(
+             std::chrono::steady_clock::now().time_since_epoch())
+      .count();
+}
+
+// dispatch: close the previous interval, open one charged to (dom, slot)
+inline void op(int dom, int slot) {
+  unsigned long long n = now_ns();
+  if (t.slot >= 0) t.ns[t.dom][t.slot] += n - t.last;
+  t.dom = dom;
+  t.slot = slot;
+  t.last = n;
+  t.hits[dom][slot]++;
+}
+
+inline void stop() {  // close the open interval without opening another
+  if (t.slot >= 0) {
+    t.ns[t.dom][t.slot] += now_ns() - t.last;
+    t.slot = -1;
+  }
+}
+
+inline void flush() {  // publish this thread's block (call on that thread)
+  stop();
+  for (int d = 0; d < N_DOM; d++) {
+    for (int s = 0; s < N_SLOT; s++) {
+      if (t.hits[d][s]) {
+        g_hits[d][s].fetch_add(t.hits[d][s], std::memory_order_relaxed);
+        t.hits[d][s] = 0;
+      }
+      if (t.ns[d][s]) {
+        g_ns[d][s].fetch_add(t.ns[d][s], std::memory_order_relaxed);
+        t.ns[d][s] = 0;
+      }
+    }
+  }
+}
+
+// snapshot-and-clear -> {"vm.op.string": (hits, ns), ...} (GIL held)
+inline PyObject* drain_py() {
+  PyObject* out = PyDict_New();
+  if (!out) return nullptr;
+  char key[48];
+  for (int d = 0; d < N_DOM; d++) {
+    for (int s = 0; s < N_SLOT; s++) {
+      unsigned long long h = g_hits[d][s].exchange(0, std::memory_order_relaxed);
+      unsigned long long n = g_ns[d][s].exchange(0, std::memory_order_relaxed);
+      if (!h && !n) continue;
+      std::snprintf(key, sizeof(key), "%s%s", kDomPrefix[d], kSlotName[s]);
+      PyObject* v = Py_BuildValue("(KK)", h, n);
+      if (!v || PyDict_SetItemString(out, key, v) != 0) {
+        Py_XDECREF(v);
+        Py_DECREF(out);
+        return nullptr;
+      }
+      Py_DECREF(v);
+    }
+  }
+  return out;
+}
+
+}  // namespace prof
+#define PYR_PROF_OP(dom, slot) ::pyr::prof::op((dom), (slot))
+#define PYR_PROF_STOP() ::pyr::prof::stop()
+#define PYR_PROF_FLUSH() ::pyr::prof::flush()
+#else
+#define PYR_PROF_OP(dom, slot) ((void)0)
+#define PYR_PROF_STOP() ((void)0)
+#define PYR_PROF_FLUSH() ((void)0)
+#endif
 
 // ---- op kinds (keep in sync with hostpath/program.py) ----------------
 enum OpKind : int32_t {
@@ -563,11 +687,14 @@ inline void run_shard_t(RecFn rec, const int32_t* coltypes, size_t ncols,
     if (r.err) {
       out->err_record = i;
       out->err_bits = r.err;
+      PYR_PROF_FLUSH();  // publish this shard thread's opcode counters
       return;
     }
   }
+  PYR_PROF_FLUSH();
 } catch (const std::bad_alloc&) {
   out->err_record = -2;
+  PYR_PROF_FLUSH();
 }
 
 // decode boundary: (coltypes, data_list, nthreads) with the decoder
@@ -592,7 +719,10 @@ inline PyObject* decode_boundary(RecFn rec, PyObject* coltypes_obj,
   std::vector<Span> spans;
   std::vector<Py_buffer> views;
   std::vector<PyObject*> pins;
-  if (!collect_spans(seq, spans, views, pins)) {
+  PYR_PROF_OP(pyr::prof::DOM_VM, pyr::prof::P_COLLECT);
+  bool spans_ok = collect_spans(seq, spans, views, pins);
+  PYR_PROF_STOP();
+  if (!spans_ok) {
     release_spans(views, pins);
     Py_DECREF(seq);
     return nullptr;
@@ -673,6 +803,7 @@ inline PyObject* decode_boundary(RecFn rec, PyObject* coltypes_obj,
   // one output buffer per column (two for COL_STR), allocated at the
   // summed size and filled per shard by build_col_buffer — COL_OFFS
   // rebases during the copy, every other type is a straight memcpy
+  PYR_PROF_OP(pyr::prof::DOM_VM, pyr::prof::P_MERGE);
   PyObject* bufs = PyList_New(0);
   if (!bufs) return nullptr;
   for (size_t c = 0; c < ncols; c++) {
@@ -695,6 +826,7 @@ inline PyObject* decode_boundary(RecFn rec, PyObject* coltypes_obj,
   }
   PyObject* out = Py_BuildValue("(OLi)", bufs, (long long)-1, 0);
   Py_DECREF(bufs);
+  PYR_PROF_FLUSH();
   return out;
 }
 
@@ -853,6 +985,7 @@ class EncVm {
 
   size_t exec(size_t pc, bool present) {
     const Op& op = ops_[pc];
+    PYR_PROF_OP(pyr::prof::DOM_ENC, op.kind);
     switch (op.kind) {
       case OP_RECORD: {
         size_t p = pc + 1, stop = pc + op.nops;
@@ -982,16 +1115,19 @@ inline void run_encode_t(Rec rec, std::vector<InCol>& cols, W& w,
   for (Py_ssize_t i = 0; i < n; i++) {
     if (!rec(w, cols)) {
       *vm_err = true;
+      PYR_PROF_FLUSH();
       return;
     }
     size_t pos = w.pos();
     if (pos > (size_t)INT32_MAX) {
       *overflow = true;
+      PYR_PROF_FLUSH();
       return;
     }
     sizes[i] = (int32_t)(pos - prev);
     prev = pos;
   }
+  PYR_PROF_FLUSH();
 }
 
 // encode boundary: (coltypes, buffers, n, size_hint) with the encoder

@@ -1,4 +1,4 @@
-"""Process-global host thread pool.
+"""Process-global host pools (thread by default, process opt-in).
 
 ≙ the reference's ``OnceLock<tokio::runtime::Runtime>``
 (``ruhvro/src/lib.rs:12-16``): created on first use, lives for the
@@ -7,21 +7,42 @@ work releases the GIL (the C++ packer, pyarrow, numpy, JAX dispatch);
 the pure-Python fallback codec is GIL-bound, so chunk threading there
 preserves the API contract rather than adding speed — the speed path is
 the TPU backend.
+
+``PYRUHVRO_TPU_POOL=process`` opts chunk fan-outs into a spawn-based
+process pool for the host tiers (``api.py`` routes eligible calls to
+:func:`map_chunks_proc`). Workers run under
+:class:`..telemetry.worker_scope` and ship their counter deltas + span
+tree back WITH each chunk result, so the parent's ``snapshot()`` still
+covers 100% of the work — nothing is dropped on the process boundary.
+
+Either way, every chunk is accounted: the per-chunk span carries the
+chunk's row count and its counter deltas, and ``pool.worker_rows`` sums
+rows over all workers (thread or process), so a chunked call's snapshot
+row accounting always reconciles with the input.
 """
 
 from __future__ import annotations
 
 import os
 import threading
-from concurrent.futures import ThreadPoolExecutor
-from typing import Callable, List, Sequence
+from concurrent.futures import ProcessPoolExecutor, ThreadPoolExecutor
+from typing import Callable, List, Optional, Sequence
 
 from . import metrics, telemetry
 
-__all__ = ["get_pool", "map_chunks"]
+__all__ = ["get_pool", "map_chunks", "get_process_pool", "map_chunks_proc",
+           "pool_mode"]
 
 _pool = None
+_proc_pool = None
+_proc_broken = False
 _lock = threading.Lock()
+
+
+def pool_mode() -> str:
+    """``thread`` (default) or ``process`` (PYRUHVRO_TPU_POOL)."""
+    mode = os.environ.get("PYRUHVRO_TPU_POOL", "thread")
+    return mode if mode in ("thread", "process") else "thread"
 
 
 def get_pool() -> ThreadPoolExecutor:
@@ -36,24 +57,102 @@ def get_pool() -> ThreadPoolExecutor:
     return _pool
 
 
-def map_chunks(fn: Callable, chunks: Sequence) -> List:
-    """Run ``fn`` over chunks on the pool, preserving order; a single
-    chunk runs inline (no thread hop).
+def get_process_pool() -> ProcessPoolExecutor:
+    """The spawn-based process pool (lazy, process-lifetime). Spawn, not
+    fork: the parent holds live pool threads (and possibly a JAX
+    runtime) whose locks a forked child could inherit mid-acquire."""
+    global _proc_pool
+    if _proc_pool is None:
+        with _lock:
+            if _proc_pool is None:
+                import multiprocessing
+
+                _proc_pool = ProcessPoolExecutor(
+                    max_workers=min(os.cpu_count() or 4, 8),
+                    mp_context=multiprocessing.get_context("spawn"),
+                )
+    return _proc_pool
+
+
+def map_chunks(fn: Callable, chunks: Sequence,
+               rows: Optional[Callable] = None) -> List:
+    """Run ``fn`` over chunks on the thread pool, preserving order; a
+    single chunk runs inline (no thread hop).
 
     Each chunk runs under a ``pool.chunk_s`` span parented to the
     CALLING thread's open span (worker threads have no span context of
-    their own), so the fan-out shows up in the call tree."""
+    their own), so the fan-out shows up in the call tree. ``rows``
+    (optional) maps a chunk to its row count: it lands on the chunk's
+    span, feeds the ``pool.worker_rows`` reconciliation counter, and the
+    chunk's own counter deltas are attached to its span — per-worker
+    attribution inside one snapshot."""
     metrics.inc("pool.chunks", len(chunks))
+
+    def run_one(i, chunk, inline=False):
+        n = rows(chunk) if rows is not None else None
+        attrs = {"chunk": i}
+        if inline:
+            attrs["inline"] = True
+        if n is not None:
+            attrs["rows"] = n
+            metrics.inc("pool.worker_rows", float(n))
+        with metrics.record_deltas() as delta, \
+                telemetry.phase("pool.chunk_s", **attrs) as ph:
+            out = fn(chunk)
+        if ph.span is not None and delta:
+            ph.span.attrs["counters"] = {
+                k: round(v, 9) for k, v in sorted(delta.items())
+            }
+        return out
+
     if len(chunks) == 1:
-        with telemetry.phase("pool.chunk_s", chunk=0, inline=True):
-            return [fn(chunks[0])]
+        return [run_one(0, chunks[0], inline=True)]
     metrics.inc("pool.fanouts")
     parent = telemetry.current_span()
 
     def run(i_chunk):
         i, chunk = i_chunk
-        with telemetry.attach(parent), \
-                telemetry.phase("pool.chunk_s", chunk=i):
-            return fn(chunk)
+        with telemetry.attach(parent):
+            return run_one(i, chunk)
 
     return list(get_pool().map(run, enumerate(chunks)))
+
+
+def map_chunks_proc(task: Callable, payloads: Sequence,
+                    rows: Optional[Callable] = None) -> List:
+    """Run ``task(payload)`` per chunk on the PROCESS pool, preserving
+    order. ``task`` must be a picklable module-level callable returning
+    ``(result, worker_payload)`` where ``worker_payload`` came from
+    :class:`..telemetry.worker_scope` — each worker's counters and span
+    tree are merged back here, so the parent snapshot covers the whole
+    fan-out. Raises whatever the pool raises (pickling errors, a broken
+    pool): callers fall back to the thread path and count it. A BROKEN
+    pool (workers that cannot start, e.g. no importable __main__ for
+    spawn) is torn down and the mode disabled for the process, so every
+    later call falls back immediately instead of re-spawning doomed
+    workers — and a wedged executor cannot hang interpreter exit."""
+    from concurrent.futures.process import BrokenProcessPool
+
+    global _proc_pool, _proc_broken
+    if _proc_broken:
+        raise RuntimeError("process pool disabled after breakage")
+    metrics.inc("pool.proc_chunks", len(payloads))
+    if len(payloads) > 1:
+        metrics.inc("pool.proc_fanouts")
+    try:
+        futures = [get_process_pool().submit(task, p) for p in payloads]
+        out = []
+        for i, fut in enumerate(futures):
+            result, payload = fut.result()
+            telemetry.merge_worker(payload)
+            out.append(result)
+            n = rows(payloads[i]) if rows is not None else None
+            if n is not None and not (payload or {}).get("rows"):
+                metrics.inc("pool.worker_rows", float(n))
+        return out
+    except BrokenProcessPool:
+        with _lock:
+            broken, _proc_pool, _proc_broken = _proc_pool, None, True
+        if broken is not None:
+            broken.shutdown(wait=False, cancel_futures=True)
+        raise

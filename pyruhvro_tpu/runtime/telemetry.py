@@ -72,6 +72,10 @@ __all__ = [
     "enabled",
     "render_report",
     "main",
+    "worker_scope",
+    "merge_worker",
+    "flight_dump",
+    "install_flight_signal",
 ]
 
 # fixed log-spaced latency buckets, 1 µs … 500 s (~3/decade); +Inf is
@@ -83,9 +87,23 @@ _BUCKET_BOUNDS: tuple = tuple(
 
 _MAX_SPANS = 64  # root spans retained for snapshot(); older ones are counted
 
+
+def _env_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
+
+
+# flight recorder: compact records of the last N root spans, kept even
+# after the span itself ages out of the snapshot ring, dumpable as a
+# post-mortem artifact (see the "flight recorder" section below)
+_FLIGHT_N = max(1, _env_int("PYRUHVRO_TPU_FLIGHT_N", 64))
+
 _lock = threading.Lock()
 _hists: Dict[str, "_Hist"] = {}
 _spans: deque = deque(maxlen=_MAX_SPANS)
+_flight: deque = deque(maxlen=_FLIGHT_N)
 _roots_seen = 0
 _enabled = os.environ.get("PYRUHVRO_TPU_NO_TELEMETRY") != "1"
 _tls = threading.local()
@@ -243,9 +261,14 @@ class root_span:
             _hist(s.name + "_s").observe(s.dur_s)
             if self._prev is None:
                 _spans.append(s)
+                _flight.append(_flight_record(s))
                 _roots_seen += 1
         if self._prev is None:
             _maybe_trace(s)
+            if exc_type is not None:
+                # a failed decode/encode leaves a replayable artifact
+                # when PYRUHVRO_TPU_FLIGHT_DIR points somewhere
+                _flight_autodump("error")
         return False
 
 
@@ -332,6 +355,218 @@ def set_route(tier: str, reason: Optional[str] = None) -> None:
             s.attrs["route_reason"] = reason
 
 
+# ---------------------------------------------------------------------------
+# flight recorder
+# ---------------------------------------------------------------------------
+#
+# A ring of the last N finished root spans, reduced to compact records
+# (schema fingerprint, routing verdict, per-phase time totals) — cheap
+# enough to stay on whenever spans are on, and dumpable as JSON when
+# something goes wrong in production: on any decode/encode error (when
+# ``PYRUHVRO_TPU_FLIGHT_DIR`` names a directory), on SIGUSR1 (same
+# gate), or explicitly via :func:`flight_dump`. ``PYRUHVRO_TPU_FLIGHT_N``
+# sizes the ring (default 64).
+
+_flight_seq = 0
+_flight_last_auto = 0.0
+_flight_signal_installed = False
+
+
+def _flight_record(s: Span) -> Dict[str, Any]:
+    phases: Dict[str, float] = {}
+
+    def walk(node: Span) -> None:
+        for c in node.children:
+            if c.dur_s is not None:
+                phases[c.name] = round(
+                    phases.get(c.name, 0.0) + c.dur_s, 9)
+            walk(c)
+
+    walk(s)
+    return {
+        "ts": round(s.ts, 6),
+        "name": s.name,
+        "dur_s": s.dur_s,
+        "attrs": dict(s.attrs),
+        "phases": phases,
+    }
+
+
+def _flight_records(blocking: bool = True) -> List[Dict[str, Any]]:
+    """Copy the ring. ``blocking=False`` is the signal-handler path: the
+    handler runs on the main thread at a bytecode boundary, possibly
+    INSIDE a ``with _lock:`` region of the very frame it interrupted —
+    blocking there would deadlock on the non-reentrant lock, so fall
+    back to a best-effort unlocked copy (the interrupted mutator is
+    paused; a concurrent thread's append at worst raises the RuntimeError
+    swallowed here)."""
+    if _lock.acquire(blocking=blocking):
+        try:
+            return list(_flight)
+        finally:
+            _lock.release()
+    try:
+        return list(_flight)
+    except RuntimeError:
+        return []
+
+
+def flight_dump(path: Optional[str] = None, *, blocking: bool = True):
+    """The flight-recorder contents: as a dict (``path=None``) or
+    written to ``path`` as JSON (returns the path)."""
+    records = _flight_records(blocking)
+    doc = {
+        "pid": os.getpid(),
+        "time": round(time.time(), 3),
+        "records": records,
+    }
+    if path is None:
+        return doc
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(doc, f, indent=1, default=str)
+    return path
+
+
+def _flight_autodump(tag: str, blocking: bool = True) -> Optional[str]:
+    """Write a flight dump into PYRUHVRO_TPU_FLIGHT_DIR (no-op when
+    unset); rate-limited to one per second so an error storm cannot
+    flood the disk, and never allowed to fail the call it observes.
+    ``blocking=False`` from signal context (see _flight_records)."""
+    global _flight_seq, _flight_last_auto
+    d = os.environ.get("PYRUHVRO_TPU_FLIGHT_DIR")
+    if not d:
+        return None
+    now = time.monotonic()
+    if now - _flight_last_auto < 1.0:
+        return None
+    _flight_last_auto = now
+    _flight_seq += 1
+    path = os.path.join(d, f"flight_{os.getpid()}_{_flight_seq}_{tag}.json")
+    try:
+        return flight_dump(path, blocking=blocking)
+    except (OSError, ValueError):
+        return None
+
+
+def install_flight_signal() -> bool:
+    """Register a SIGUSR1 handler that dumps the flight recorder into
+    PYRUHVRO_TPU_FLIGHT_DIR. Safe to call repeatedly; returns False
+    when unavailable (non-main thread, platform without SIGUSR1). The
+    previous handler is chained, not replaced."""
+    global _flight_signal_installed
+    if _flight_signal_installed:
+        return True
+    import signal
+
+    if not hasattr(signal, "SIGUSR1"):
+        return False
+
+    prev = signal.getsignal(signal.SIGUSR1)
+
+    def handler(signum, frame):
+        _flight_autodump("sigusr1", blocking=False)
+        if callable(prev) and prev not in (signal.SIG_IGN, signal.SIG_DFL):
+            prev(signum, frame)
+
+    try:
+        signal.signal(signal.SIGUSR1, handler)
+    except ValueError:  # not the main thread
+        return False
+    _flight_signal_installed = True
+    return True
+
+
+# operators who configure a dump directory get the SIGUSR1 hook without
+# any code change; everyone else pays nothing (no handler installed)
+if os.environ.get("PYRUHVRO_TPU_FLIGHT_DIR"):
+    install_flight_signal()
+
+
+# ---------------------------------------------------------------------------
+# cross-process worker telemetry
+# ---------------------------------------------------------------------------
+
+
+class worker_scope:
+    """Capture one pool/process worker's telemetry for the parent.
+
+    Wrap the worker's unit of work::
+
+        with telemetry.worker_scope("pool.worker", rows=n) as w:
+            result = do_chunk()
+        return result, w.payload
+
+    Inside the scope, a ``pool.worker`` root span times the work and
+    every counter increment is also recorded as a delta. On exit,
+    ``payload`` is a PICKLABLE dict (counter deltas + the span tree) the
+    parent folds back with :func:`merge_worker` — this is what makes
+    ``snapshot()`` cover work done in other processes, whose counters
+    and spans would otherwise be silently dropped with the worker."""
+
+    __slots__ = ("name", "attrs", "payload", "_rec", "_delta", "_root")
+
+    def __init__(self, name: str = "pool.worker", **attrs):
+        self.name = name
+        self.attrs = attrs
+        self.payload: Optional[Dict[str, Any]] = None
+
+    def __enter__(self) -> "worker_scope":
+        self._rec = metrics.record_deltas()
+        self._delta = self._rec.__enter__()
+        self._root = root_span(self.name, pid=os.getpid(), **self.attrs)
+        self._root.__enter__()
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        self._root.__exit__(exc_type, exc, tb)
+        self._rec.__exit__(exc_type, exc, tb)
+        span = self._root.span
+        self.payload = {
+            "pid": os.getpid(),
+            "rows": self.attrs.get("rows"),
+            "counters": dict(self._delta),
+            "span": span.to_dict() if span is not None else None,
+        }
+        return False
+
+
+def _span_from_dict(d: Dict[str, Any]) -> Span:
+    s = Span(d.get("name", "?"), dict(d.get("attrs") or {}))
+    ts = d.get("ts")
+    if ts is not None:
+        s.ts = ts
+    s.dur_s = d.get("dur_s")
+    s.children = [_span_from_dict(c) for c in d.get("children") or []]
+    return s
+
+
+def merge_worker(payload: Dict[str, Any], *, counters: bool = True) -> None:
+    """Fold a worker's exported telemetry into THIS process.
+
+    ``counters=True`` (process workers): the delta dict adds into the
+    flat counter layer, so phase totals cover 100% of the work; pass
+    ``counters=False`` for same-process thread workers whose increments
+    already landed. Either way the worker's span tree re-parents under
+    the caller's current open span (so the call tree shows the remote
+    chunk), ``pool.worker_rows`` accumulates the worker's row count and
+    ``pool.worker_merges`` counts the merge itself."""
+    if not payload:
+        return
+    if counters:
+        metrics.merge(payload.get("counters") or {})
+        rows = payload.get("rows")
+        if rows:
+            metrics.inc("pool.worker_rows", float(rows))
+    metrics.inc("pool.worker_merges")
+    sd = payload.get("span")
+    if sd and _enabled:
+        parent = getattr(_tls, "span", None)
+        if parent is not None:
+            s = _span_from_dict(sd)
+            with _lock:
+                parent.children.append(s)
+
+
 def set_enabled(flag: bool) -> None:
     """Toggle spans + histograms (flat counters always stay on)."""
     global _enabled
@@ -345,11 +580,13 @@ def enabled() -> bool:
 def reset() -> None:
     """Clear spans, histograms AND the flat counters (test isolation);
     also closes any open trace sink so redirected streams don't leak."""
-    global _roots_seen, _trace_memo
+    global _roots_seen, _trace_memo, _flight_last_auto
     with _lock:
         _hists.clear()
         _spans.clear()
+        _flight.clear()
         _roots_seen = 0
+        _flight_last_auto = 0.0  # re-arm the auto-dump rate limiter
     with _trace_lock:
         if _trace_memo is not None:
             fh = _trace_memo[1]
@@ -375,11 +612,13 @@ def snapshot() -> Dict[str, Any]:
         hists = {k: h.summary() for k, h in sorted(_hists.items())}
         spans = [s.to_dict() for s in _spans]
         dropped = _roots_seen - len(_spans)
+        flight_n = len(_flight)
     return {
         "counters": metrics.snapshot(),
         "histograms": hists,
         "spans": spans,
         "spans_dropped": dropped,
+        "flight_records": flight_n,
     }
 
 
@@ -402,10 +641,12 @@ def prometheus(snap: Optional[Dict[str, Any]] = None) -> str:
     lines: List[str] = []
     for key, v in sorted(snap.get("counters", {}).items()):
         name = _prom_name(key) + "_total"
+        lines.append(f"# HELP {name} pyruhvro_tpu counter {key}")
         lines.append(f"# TYPE {name} counter")
         lines.append(f"{name} {float(v)!r}")
     for key, h in sorted(snap.get("histograms", {}).items()):
         name = _prom_name(key)
+        lines.append(f"# HELP {name} pyruhvro_tpu latency histogram {key}")
         lines.append(f"# TYPE {name} histogram")
         seen_inf = False
         for le, cum in h.get("buckets", []):
@@ -502,6 +743,44 @@ def _phase_table(hists: Dict[str, Any], seconds: Dict[str, float]) -> List[str]:
     return rows
 
 
+# native-profiler key families (ISSUE 3): rendered as their own section,
+# kept out of the generic phase/counter tables. Each maps to the parent
+# phase its self-times decompose.
+_PROF_FAMILIES = (
+    ("vm.op.", "host.vm_s"),
+    ("vm.encop.", "host.encode_vm_s"),
+    ("extract.op.", "host.extract_native_s"),
+)
+_PROF_PREFIXES = tuple(p for p, _ in _PROF_FAMILIES)
+
+
+def _prof_tables(counters: Dict[str, float]) -> List[str]:
+    out: List[str] = []
+    for pfx, parent_key in _PROF_FAMILIES:
+        entries: Dict[str, list] = {}
+        for k, v in counters.items():
+            if not k.startswith(pfx):
+                continue
+            name = k[len(pfx):]
+            if name.endswith("_s"):
+                entries.setdefault(name[:-2], [0.0, 0.0])[1] = v
+            else:
+                entries.setdefault(name, [0.0, 0.0])[0] = v
+        if not entries:
+            continue
+        tot = sum(s for _h, s in entries.values())
+        parent = counters.get(parent_key)
+        head = f"{pfx}* ({tot * 1e3:.3f} ms self time"
+        if parent:
+            head += f" = {tot / parent * 100:.1f}% of {parent_key}"
+        out.append(head + ")")
+        for name, (h, s) in sorted(entries.items(), key=lambda kv: -kv[1][1]):
+            share = (s / tot * 100) if tot else 0.0
+            out.append(f"  {name:<12} {h:>12.0f} hits "
+                       f"{s * 1e3:>10.3f} ms {share:>5.1f}%")
+    return out
+
+
 def _render_span(s: Dict[str, Any], indent: int, out: List[str]) -> None:
     attrs = " ".join(f"{k}={v}" for k, v in s.get("attrs", {}).items())
     dur = s.get("dur_s")
@@ -550,17 +829,33 @@ def render_report(data: Dict[str, Any]) -> str:
         out.extend(_phase_table(
             hists,
             {k: v for k, v in counters.items()
-             if k.endswith("_s") and k not in hists},
+             if k.endswith("_s") and k not in hists
+             and not k.startswith(_PROF_PREFIXES)},
         ))
+        prof = _prof_tables(counters)
+        if prof:
+            out += ["", "== native profiler (per-opcode self time) =="]
+            out.extend(prof)
+        workers = {k: v for k, v in counters.items()
+                   if k.startswith(("pool.worker", "pool.proc"))}
+        if workers.get("pool.worker_rows") or workers.get("pool.worker_merges"):
+            out += ["", "== pool workers =="]
+            out.extend(f"{k:<36} {v:>14.0f}"
+                       for k, v in sorted(workers.items()))
         routes = {k: v for k, v in counters.items() if k.startswith("route.")}
         if routes:
             out += ["", "== routing =="]
             out.extend(f"{k:<36} {v:>10.0f}" for k, v in sorted(routes.items()))
         other = {k: v for k, v in counters.items()
-                 if not k.endswith("_s") and not k.startswith("route.")}
+                 if not k.endswith("_s") and not k.startswith("route.")
+                 and not k.startswith(_PROF_PREFIXES)
+                 and k not in workers}
         if other:
             out += ["", "== counters =="]
             out.extend(f"{k:<36} {v:>14.0f}" for k, v in sorted(other.items()))
+        if data.get("flight_records"):
+            out += ["", f"flight recorder: {data['flight_records']} record(s)"
+                        " buffered (telemetry.flight_dump())"]
         spans = data.get("spans") or []
         if spans:
             out += ["", "== last call span =="]
@@ -587,18 +882,38 @@ def main(argv: Optional[List[str]] = None) -> int:
         "prom", help="Prometheus text format from a snapshot JSON")
     p_prom.add_argument("path")
     args = ap.parse_args(argv)
+
+    def _usage_error(msg: str) -> int:
+        # a missing/malformed snapshot is an operator mistake, not a
+        # crash: name the problem, show the usage, exit 2 (satellite)
+        print(f"error: {msg}", file=sys.stderr)
+        ap.print_usage(sys.stderr)
+        print("hint: <file> is a JSON dict saved from "
+              "telemetry.snapshot() (or, for 'report', a "
+              "BENCH_DETAILS.json)", file=sys.stderr)
+        return 2
+
     try:
         with open(args.path, encoding="utf-8") as f:
             data = json.load(f)
-    except (OSError, ValueError) as e:
-        print(f"cannot read {args.path}: {e}", file=sys.stderr)
-        return 2
+    except OSError as e:
+        return _usage_error(f"cannot read {args.path}: {e}")
+    except ValueError as e:
+        return _usage_error(f"{args.path} is not valid JSON: {e}")
+    if not isinstance(data, dict):
+        return _usage_error(
+            f"{args.path} holds a JSON {type(data).__name__}, not a "
+            "snapshot object")
     if args.cmd == "report":
+        if not ({"results", "counters", "histograms"} & set(data)):
+            return _usage_error(
+                f"{args.path} has none of the expected keys "
+                "('results' / 'counters' / 'histograms')")
         sys.stdout.write(render_report(data))
     else:
         if "counters" not in data and "histograms" not in data:
-            print("not a telemetry snapshot (expected 'counters'/"
-                  "'histograms' keys)", file=sys.stderr)
-            return 2
+            return _usage_error(
+                "not a telemetry snapshot (expected 'counters'/"
+                "'histograms' keys)")
         sys.stdout.write(prometheus(data))
     return 0

@@ -1,0 +1,273 @@
+#!/usr/bin/env python
+"""Perf-regression gate: fresh best-of-N bands vs PERF_BASELINE.json.
+
+The machine-checked tripwire behind every perf PR (ISSUE 3): measures
+the gate cases (kafka 10k-row host decode + encode, the headline
+workload of BENCH_r0*) with bench.py's exact best-of-N protocol,
+compares each case's MEDIAN against the committed baseline, and exits
+non-zero when any case regressed more than the tolerance (default 15%).
+Every run appends a line to the bench trajectory
+(``BENCH_TRAJECTORY.jsonl``) and saves the run's full telemetry snapshot
+(``telemetry_snapshot.json``) so a red gate arrives with its own
+evidence (phase breakdown, routing, per-opcode profile when
+``PYRUHVRO_TPU_NATIVE_PROF=1``).
+
+Cross-machine honesty: raw wall-clock baselines only compare on the
+machine that produced them, so the baseline stores a ``calib_s``
+measured by a fixed numpy workload; the gate measures the same workload
+locally and rescales the baseline medians by the ratio (clamped to
+[0.25, 4]) before comparing. ``--update-baseline`` reseeds the baseline
+from this machine's fresh run.
+
+Usage::
+
+    python scripts/perf_gate.py                       # measure + compare
+    python scripts/perf_gate.py --details FILE        # compare a saved run
+    python scripts/perf_gate.py --update-baseline     # reseed the baseline
+    python scripts/perf_gate.py --tolerance 0.25      # loosen the gate
+
+Exit codes: 0 = within tolerance, 1 = regression, 2 = usage/baseline
+problem.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+from typing import Dict, Optional
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+DEFAULT_BASELINE = os.path.join(REPO, "PERF_BASELINE.json")
+DEFAULT_TRAJECTORY = os.path.join(REPO, "BENCH_TRAJECTORY.jsonl")
+DEFAULT_SNAPSHOT = os.path.join(REPO, "telemetry_snapshot.json")
+DEFAULT_TOLERANCE = 0.15
+
+
+def _log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+def case_key(schema: str, op: str, backend: str, rows: int,
+             chunks: int) -> str:
+    return f"{schema}/{op}/{backend}/{rows}x{chunks}"
+
+
+def calibrate() -> float:
+    """A fixed CPU+memory workload (numpy xor/cumsum over 8M int64):
+    the unit the baseline's wall-clock medians are expressed against, so
+    a committed baseline transfers across machines of different speed
+    without re-measuring the library itself (which would be circular)."""
+    import numpy as np
+
+    a = np.arange(1 << 23, dtype=np.int64)
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        b = a ^ (a >> 7)
+        c = np.cumsum(b, dtype=np.int64)
+        _ = int(c[-1])
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def measure_cases(rows: int, chunks: int, reps: int) -> Dict[str, dict]:
+    """The gate cases with bench.py's protocol (one untimed warmup, all
+    reps recorded, band = {n, min_s, median_s}) — host tier only: the
+    gate must be deterministic wherever CI happens to run."""
+    from bench import _band, _gen_kafka, _time_reps  # noqa: E402
+    from pyruhvro_tpu.api import (
+        deserialize_array,
+        deserialize_array_threaded,
+        serialize_record_batch,
+    )
+    from pyruhvro_tpu.utils.datagen import KAFKA_SCHEMA_JSON as K
+
+    datums = _gen_kafka(rows)
+    out: Dict[str, dict] = {}
+
+    times = _time_reps(
+        lambda: deserialize_array_threaded(datums, K, chunks,
+                                           backend="host"), reps)
+    out[case_key("kafka", "deserialize", "host", rows, chunks)] = _band(times)
+
+    batch = deserialize_array(datums, K, backend="host")
+    times = _time_reps(
+        lambda: serialize_record_batch(batch, K, chunks, backend="host"),
+        reps)
+    out[case_key("kafka", "serialize", "host", rows, chunks)] = _band(times)
+    for key, band in out.items():
+        _log(f"[perf-gate] {key}: median {band['median_s'] * 1e3:.3f} ms "
+             f"(min {band['min_s'] * 1e3:.3f} ms, n={band['n']})")
+    return out
+
+
+def load_details(path: str) -> Dict[str, dict]:
+    """Medians from a saved run: either a baseline-style file
+    ({"cases": {key: {"median_s"}}}) or a BENCH_DETAILS.json (results
+    rows carrying a band)."""
+    with open(path, encoding="utf-8") as f:
+        data = json.load(f)
+    if not isinstance(data, dict):
+        raise ValueError(f"{path}: expected a JSON object")
+    if "cases" in data:
+        return {k: dict(v) for k, v in data["cases"].items()}
+    out = {}
+    for r in data.get("results", []):
+        band = r.get("band")
+        if not band:
+            continue
+        key = case_key(r.get("schema", "?"), r.get("op", "?"),
+                       r.get("backend", "?"), r.get("rows", 0),
+                       r.get("chunks", 0))
+        out[key] = dict(band)
+    if not out:
+        raise ValueError(f"{path}: no banded results to compare")
+    return out
+
+
+def compare(fresh: Dict[str, dict], baseline: dict, tolerance: float,
+            scale: float) -> list:
+    """-> list of (key, fresh_median, allowed, regressed) for every case
+    present in BOTH the fresh run and the baseline."""
+    rows = []
+    for key, base in sorted(baseline.get("cases", {}).items()):
+        f = fresh.get(key)
+        if f is None:
+            continue
+        allowed = base["median_s"] * scale * (1.0 + tolerance)
+        rows.append((key, f["median_s"], allowed, f["median_s"] > allowed))
+    return rows
+
+
+def append_trajectory(path: str, entry: dict) -> None:
+    with open(path, "a", encoding="utf-8") as f:
+        f.write(json.dumps(entry, sort_keys=True) + "\n")
+
+
+def save_snapshot(path: str) -> None:
+    from pyruhvro_tpu.runtime import telemetry
+
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(telemetry.snapshot(), f, indent=1, default=str)
+    _log(f"[perf-gate] telemetry snapshot -> {path}")
+
+
+def main(argv: Optional[list] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="perf_gate.py",
+        description="fail on >tolerance median regression vs "
+                    "PERF_BASELINE.json")
+    ap.add_argument("--baseline", default=DEFAULT_BASELINE)
+    ap.add_argument("--details",
+                    help="compare this saved run (baseline-style 'cases' "
+                         "dict or BENCH_DETAILS.json) instead of measuring")
+    ap.add_argument("--rows", type=int,
+                    default=int(os.environ.get("PERF_GATE_ROWS", 10_000)))
+    ap.add_argument("--chunks", type=int, default=8)
+    ap.add_argument("--reps", type=int,
+                    default=int(os.environ.get("PERF_GATE_REPS", 5)))
+    ap.add_argument("--tolerance", type=float,
+                    default=float(os.environ.get(
+                        "PYRUHVRO_TPU_PERF_TOLERANCE", DEFAULT_TOLERANCE)))
+    ap.add_argument("--trajectory", default=DEFAULT_TRAJECTORY)
+    ap.add_argument("--no-trajectory", dest="trajectory",
+                    action="store_const", const=None)
+    ap.add_argument("--snapshot-out", default=DEFAULT_SNAPSHOT)
+    ap.add_argument("--update-baseline", action="store_true",
+                    help="reseed the baseline from this run and exit 0")
+    args = ap.parse_args(argv)
+
+    try:
+        with open(args.baseline, encoding="utf-8") as f:
+            baseline = json.load(f)
+    except (OSError, ValueError) as e:
+        if not args.update_baseline:
+            _log(f"[perf-gate] error: cannot read baseline "
+                 f"{args.baseline}: {e}")
+            ap.print_usage(sys.stderr)
+            return 2
+        baseline = {}
+
+    if args.details:
+        try:
+            fresh = load_details(args.details)
+        except (OSError, ValueError) as e:
+            _log(f"[perf-gate] error: {e}")
+            ap.print_usage(sys.stderr)
+            return 2
+        calib = None  # a saved run carries no calibration context
+        scale = 1.0
+    else:
+        calib = calibrate()
+        base_calib = baseline.get("calib_s")
+        scale = 1.0
+        if base_calib:
+            scale = min(4.0, max(0.25, calib / base_calib))
+            _log(f"[perf-gate] calibration {calib * 1e3:.1f} ms "
+                 f"(baseline {base_calib * 1e3:.1f} ms, scale {scale:.2f})")
+        else:
+            _log(f"[perf-gate] calibration {calib * 1e3:.1f} ms "
+                 "(no baseline calibration; raw comparison)")
+        fresh = measure_cases(args.rows, args.chunks, args.reps)
+        if args.snapshot_out:
+            try:
+                save_snapshot(args.snapshot_out)
+            except Exception as e:  # noqa: BLE001 — artifact, not verdict
+                _log(f"[perf-gate] snapshot save failed: {e!r}")
+
+    if args.update_baseline:
+        doc = {
+            "note": "perf_gate.py baseline: per-case best-of-N medians; "
+                    "wall seconds on the machine named below, rescaled "
+                    "across machines via calib_s (see scripts/"
+                    "perf_gate.py). Reseed with --update-baseline.",
+            "tolerance": args.tolerance,
+            "calib_s": calib,
+            "machine": {"cpus": os.cpu_count()},
+            "cases": fresh,
+        }
+        with open(args.baseline, "w", encoding="utf-8") as f:
+            json.dump(doc, f, indent=1, sort_keys=True)
+            f.write("\n")
+        _log(f"[perf-gate] baseline reseeded -> {args.baseline}")
+        return 0
+
+    rows = compare(fresh, baseline, args.tolerance, scale)
+    if not rows:
+        _log("[perf-gate] error: no overlapping cases between the run "
+             "and the baseline")
+        return 2
+    failed = False
+    for key, med, allowed, regressed in rows:
+        verdict = "REGRESSED" if regressed else "ok"
+        _log(f"[perf-gate] {key}: {med * 1e3:.3f} ms vs allowed "
+             f"{allowed * 1e3:.3f} ms -> {verdict}")
+        failed = failed or regressed
+    if args.trajectory:
+        try:
+            append_trajectory(args.trajectory, {
+                "kind": "perf_gate",
+                "utc": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+                "tolerance": args.tolerance,
+                "scale": round(scale, 4),
+                "pass": not failed,
+                "cases": {k: {"median_s": m, "allowed_s": round(a, 6)}
+                          for k, m, a, _r in rows},
+            })
+        except OSError as e:
+            _log(f"[perf-gate] trajectory append failed: {e!r}")
+    print(json.dumps({
+        "metric": "perf_gate",
+        "pass": not failed,
+        "cases": {k: round(m, 6) for k, m, _a, _r in rows},
+    }))
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

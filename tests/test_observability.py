@@ -1,0 +1,538 @@
+"""Deep observability (ISSUE 3): native-tier per-opcode profiler,
+cross-process/worker telemetry merge, flight recorder, trace-stream
+concurrency, Prometheus histogram series, CLI error surface, and the
+perf-regression gate.
+
+Host-tier only (deterministic wherever tier-1 runs); the native-profiler
+tests skip when no C++ toolchain is available, everything else holds on
+the pure-Python fallback too.
+"""
+
+import importlib.util
+import json
+import os
+import pickle
+import signal
+import subprocess
+import sys
+import threading
+
+import pytest
+
+from pyruhvro_tpu import (
+    deserialize_array,
+    deserialize_array_threaded,
+    serialize_record_batch,
+    telemetry,
+)
+from pyruhvro_tpu.runtime import metrics
+from pyruhvro_tpu.schema.cache import get_or_parse_schema
+from pyruhvro_tpu.utils.datagen import (
+    KAFKA_SCHEMA_JSON,
+    kafka_style_datums,
+    random_datums,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+SCHEMA = json.dumps({
+    "type": "record",
+    "name": "ObsT",
+    "fields": [
+        {"name": "a", "type": "long"},
+        {"name": "b", "type": "string"},
+    ],
+})
+
+
+def _datums(n=100, seed=11):
+    return random_datums(get_or_parse_schema(SCHEMA).ir, n, seed=seed)
+
+
+def _native_ok():
+    try:
+        from pyruhvro_tpu.hostpath import native_available
+
+        return native_available()
+    except Exception:
+        return False
+
+
+# ---------------------------------------------------------------------------
+# native-tier profiler
+# ---------------------------------------------------------------------------
+
+# a doc tweak gives a FRESH schema-cache entry (and so a fresh codec that
+# sees the profiler env) while keeping the kafka wire format identical
+KAFKA_PROF = json.dumps(
+    dict(json.loads(KAFKA_SCHEMA_JSON), doc="native-prof acceptance")
+)
+
+
+@pytest.mark.skipif(not _native_ok(), reason="no C++ toolchain")
+def test_native_prof_decomposes_vm_time(monkeypatch):
+    """Acceptance: with PYRUHVRO_TPU_NATIVE_PROF=1, a 10k-row kafka host
+    decode+encode snapshot decomposes >=90% of host.vm_s into per-opcode
+    self-time keys, and the encode/extract sides report their own
+    families."""
+    monkeypatch.setenv("PYRUHVRO_TPU_NATIVE_PROF", "1")
+    monkeypatch.setenv("PYRUHVRO_TPU_VM_THREADS", "1")  # self-time == wall
+    data = kafka_style_datums(10_000, seed=7)
+    batch = deserialize_array(data, KAFKA_PROF, backend="host")
+    telemetry.reset()
+    batch = deserialize_array(data, KAFKA_PROF, backend="host")
+    serialize_record_batch(batch, KAFKA_PROF, 1, backend="host")
+    c = telemetry.snapshot()["counters"]
+
+    vm_op_s = sum(v for k, v in c.items()
+                  if k.startswith("vm.op.") and k.endswith("_s"))
+    assert c.get("host.vm_s"), c
+    coverage = vm_op_s / c["host.vm_s"]
+    assert coverage >= 0.9, (coverage, {k: v for k, v in c.items()
+                                        if k.startswith("vm.op.")})
+    # decode VM: every row dispatches at least its record opcode, and the
+    # kafka schema is string-heavy — the fast-lane loop must attribute
+    assert c.get("vm.op.record", 0) >= 10_000
+    assert c.get("vm.op.string", 0) >= 10_000
+    assert c.get("vm.op.string_s", 0) > 0
+    # encode side: either the fused Arrow-native lane ran (vm.encop.* in
+    # the extract module + extract.op.* walk) or the buffer-fed VM did
+    enc_s = sum(v for k, v in c.items()
+                if k.startswith("vm.encop.") and k.endswith("_s"))
+    assert enc_s > 0
+    if c.get("extract.native"):
+        assert any(k.startswith("extract.op.") for k in c)
+
+
+@pytest.mark.skipif(not _native_ok(), reason="no C++ toolchain")
+def test_native_prof_off_by_default():
+    data = kafka_style_datums(200, seed=3)
+    telemetry.reset()
+    deserialize_array(data, KAFKA_SCHEMA_JSON, backend="host")
+    c = telemetry.snapshot()["counters"]
+    assert not any(k.startswith(("vm.op.", "vm.encop.", "extract.op."))
+                   for k in c), c
+
+
+# ---------------------------------------------------------------------------
+# worker telemetry: thread-pool attribution + process payload round-trip
+# ---------------------------------------------------------------------------
+
+
+def test_thread_pool_chunk_rows_reconcile(monkeypatch):
+    """Every pool chunk carries its row count + counter deltas, and
+    pool.worker_rows sums to the call's input rows (fallback tier: the
+    native tier serves small batches in one pass without the pool)."""
+    monkeypatch.setenv("PYRUHVRO_TPU_NO_NATIVE", "1")
+    data = _datums(400)
+    deserialize_array_threaded(data, SCHEMA, 4, backend="host")  # warm
+    telemetry.reset()
+    out = deserialize_array_threaded(data, SCHEMA, 4, backend="host")
+    assert sum(b.num_rows for b in out) == 400
+    snap = telemetry.snapshot()
+    assert snap["counters"].get("pool.worker_rows") == 400
+    root = snap["spans"][-1]
+    chunks = [s for s in root.get("children", [])
+              if s["name"] == "pool.chunk_s"]
+    assert len(chunks) == 4
+    assert sum(s["attrs"].get("rows", 0) for s in chunks) == 400
+    assert all(isinstance(s["attrs"].get("counters"), dict)
+               for s in chunks)
+    # per-chunk attribution: each chunk's delta saw its own decode phase
+    assert all("fallback.decode_s" in s["attrs"]["counters"]
+               for s in chunks)
+
+
+@pytest.mark.skipif(not _native_ok(), reason="no C++ toolchain")
+def test_encode_threaded_pool_rows_reconcile(monkeypatch):
+    """Acceptance: a chunked encode_threaded call's snapshot row counts
+    equal the sum over all pool workers (per-chunk mode forced by
+    shrinking the chunk threshold)."""
+    from pyruhvro_tpu.hostpath.codec import NativeHostCodec
+
+    data = kafka_style_datums(256, seed=5)
+    batch = deserialize_array(data, KAFKA_SCHEMA_JSON, backend="host")
+    monkeypatch.setattr(NativeHostCodec, "_PER_CHUNK_ROWS", 16)
+    telemetry.reset()
+    arrs = serialize_record_batch(batch, KAFKA_SCHEMA_JSON, 4,
+                                  backend="host")
+    assert sum(len(a) for a in arrs) == 256
+    snap = telemetry.snapshot()
+    assert snap["counters"].get("pool.worker_rows") == 256
+    root = snap["spans"][-1]
+    chunks = [s for s in root.get("children", [])
+              if s["name"] == "pool.chunk_s"]
+    assert chunks and sum(s["attrs"].get("rows", 0) for s in chunks) == 256
+
+
+def test_worker_scope_payload_pickles_and_merges():
+    """The worker payload survives a pickle round-trip (the process
+    boundary) and merge_worker folds counters + span into the parent."""
+    with telemetry.worker_scope("pool.worker", rows=7, op="decode") as w:
+        metrics.inc("host.vm_s", 0.25)
+        metrics.inc("extract.native", 2)
+    payload = pickle.loads(pickle.dumps(w.payload))
+    assert payload["rows"] == 7
+    assert payload["counters"]["host.vm_s"] == 0.25
+    assert payload["span"]["name"] == "pool.worker"
+
+    telemetry.reset()
+    with telemetry.root_span("api.parent", rows=7):
+        telemetry.merge_worker(payload)
+    snap = telemetry.snapshot()
+    c = snap["counters"]
+    assert c.get("host.vm_s") == 0.25
+    assert c.get("extract.native") == 2
+    assert c.get("pool.worker_rows") == 7
+    assert c.get("pool.worker_merges") == 1
+    root = snap["spans"][-1]
+    kids = [s["name"] for s in root.get("children", [])]
+    assert "pool.worker" in kids
+
+
+_PROC_SCRIPT = """
+import os, sys
+from pyruhvro_tpu import (deserialize_array, deserialize_array_threaded,
+                          serialize_record_batch, telemetry)
+from pyruhvro_tpu.schema.cache import get_or_parse_schema
+from pyruhvro_tpu.utils.datagen import random_datums
+
+SCHEMA = %r
+
+def main():
+    data = random_datums(get_or_parse_schema(SCHEMA).ir, 200, seed=11)
+    batch = deserialize_array(data, SCHEMA, backend="host")
+    telemetry.reset()
+    out = deserialize_array_threaded(data, SCHEMA, 4, backend="host")
+    assert sum(b.num_rows for b in out) == 200, out
+    snap = telemetry.snapshot()
+    c = snap["counters"]
+    assert c.get("pool.process_fallback") is None, c
+    assert c.get("pool.proc_chunks") == 4, c
+    assert c.get("pool.worker_merges") == 4, c
+    assert c.get("pool.worker_rows") == 200, c
+    workers = [s for s in snap["spans"][-1].get("children", [])
+               if s["name"] == "pool.worker"]
+    assert len(workers) == 4, snap["spans"][-1]
+    pids = {w["attrs"].get("pid") for w in workers}
+    assert pids and os.getpid() not in pids, pids
+    assert sum(w["attrs"].get("rows", 0) for w in workers) == 200
+    # the workers' own phase counters merged into THIS snapshot
+    assert any(k.startswith(("host.", "fallback.")) and k.endswith("_s")
+               for k in c), c
+    telemetry.reset()
+    arrs = serialize_record_batch(batch, SCHEMA, 2, backend="host")
+    assert sum(len(a) for a in arrs) == 200
+    assert telemetry.snapshot()["counters"].get("pool.worker_rows") == 200
+    print("PROC-POOL-OK")
+
+if __name__ == "__main__":
+    main()
+""" % SCHEMA
+
+
+@pytest.mark.slow
+def test_process_pool_mode_merges_worker_telemetry(tmp_path):
+    """PYRUHVRO_TPU_POOL=process: chunks decode in spawn workers, their
+    counters/spans/rows merge into the parent snapshot (run as a real
+    script: spawn needs an importable __main__)."""
+    script = tmp_path / "proc_pool_check.py"
+    script.write_text(_PROC_SCRIPT)
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               PYRUHVRO_TPU_POOL="process",
+               PYTHONPATH=REPO + os.pathsep + os.environ.get("PYTHONPATH", ""))
+    r = subprocess.run([sys.executable, str(script)], capture_output=True,
+                       text=True, env=env, cwd=REPO, timeout=300)
+    assert r.returncode == 0, (r.stdout, r.stderr)
+    assert "PROC-POOL-OK" in r.stdout
+
+
+def test_process_pool_default_off():
+    telemetry.reset()
+    deserialize_array_threaded(_datums(40), SCHEMA, 2, backend="host")
+    c = telemetry.snapshot()["counters"]
+    assert c.get("pool.proc_chunks") is None
+
+
+# ---------------------------------------------------------------------------
+# flight recorder
+# ---------------------------------------------------------------------------
+
+
+def test_flight_records_and_dump(tmp_path):
+    data = _datums(50)
+    deserialize_array(data, SCHEMA, backend="host")
+    deserialize_array_threaded(data, SCHEMA, 2, backend="host")
+    snap = telemetry.snapshot()
+    assert snap["flight_records"] == 2
+    doc = telemetry.flight_dump()
+    assert len(doc["records"]) == 2
+    rec = doc["records"][-1]
+    assert rec["name"] == "api.deserialize_array_threaded"
+    assert rec["attrs"]["schema"] == get_or_parse_schema(SCHEMA).fingerprint
+    assert rec["attrs"]["route"] in ("native", "fallback")
+    assert rec["phases"], rec  # per-phase time totals survive compaction
+    assert all(v >= 0 for v in rec["phases"].values())
+    p = tmp_path / "dump.json"
+    assert telemetry.flight_dump(str(p)) == str(p)
+    on_disk = json.loads(p.read_text())
+    assert on_disk["records"] == doc["records"]
+    telemetry.reset()
+    assert telemetry.flight_dump()["records"] == []
+
+
+def test_flight_autodump_on_error(tmp_path, monkeypatch):
+    monkeypatch.setenv("PYRUHVRO_TPU_FLIGHT_DIR", str(tmp_path))
+    data = _datums(20)
+    deserialize_array(data, SCHEMA, backend="host")
+    with pytest.raises(Exception):
+        deserialize_array([b"\xff\xff\xff"] + data, SCHEMA, backend="host")
+    files = [f for f in os.listdir(tmp_path) if f.startswith("flight_")]
+    assert len(files) == 1, files
+    assert "_error" in files[0]
+    doc = json.loads((tmp_path / files[0]).read_text())
+    errored = [r for r in doc["records"] if r["attrs"].get("error")]
+    assert errored, doc["records"]
+
+
+@pytest.mark.skipif(not hasattr(signal, "SIGUSR1"), reason="no SIGUSR1")
+def test_flight_sigusr1_dump(tmp_path, monkeypatch):
+    monkeypatch.setenv("PYRUHVRO_TPU_FLIGHT_DIR", str(tmp_path))
+    assert telemetry.install_flight_signal()
+    deserialize_array(_datums(10), SCHEMA, backend="host")
+    os.kill(os.getpid(), signal.SIGUSR1)
+    files = [f for f in os.listdir(tmp_path) if "sigusr1" in f]
+    assert len(files) == 1, os.listdir(tmp_path)
+
+
+def test_flight_ring_is_bounded():
+    for i in range(70):
+        with telemetry.root_span("api.probe", i=i):
+            pass
+    doc = telemetry.flight_dump()
+    assert len(doc["records"]) == 64  # default PYRUHVRO_TPU_FLIGHT_N
+    assert doc["records"][-1]["attrs"]["i"] == 69
+
+
+# ---------------------------------------------------------------------------
+# JSON-lines trace stream under concurrency (satellite)
+# ---------------------------------------------------------------------------
+
+
+def test_trace_stream_concurrent_chunked_calls(tmp_path, monkeypatch):
+    """One valid JSON object per line, no interleaving, under concurrent
+    chunked calls from many threads."""
+    p = tmp_path / "trace.jsonl"
+    monkeypatch.setenv("PYRUHVRO_TPU_TRACE", str(p))
+    data = _datums(120)
+    deserialize_array_threaded(data, SCHEMA, 4, backend="host")  # warm
+    telemetry.reset()  # closes + re-resolves the sink on next write
+    CALLS, T = 4, 6
+    errs = []
+
+    def worker():
+        try:
+            for _ in range(CALLS):
+                deserialize_array_threaded(data, SCHEMA, 3, backend="host")
+        except Exception as e:  # noqa: BLE001 — surfaced by the assert
+            errs.append(e)
+
+    threads = [threading.Thread(target=worker) for _ in range(T)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errs
+    lines = p.read_text().strip().splitlines()
+    assert len(lines) == CALLS * T + 1  # +1 from the warm call
+    for ln in lines:
+        d = json.loads(ln)  # every line parses alone = no interleaving
+        assert d["name"] == "api.deserialize_array_threaded"
+        assert d["attrs"]["route_reason"] == "backend_host"
+
+
+def test_trace_sink_reresolved_after_reset(tmp_path, monkeypatch):
+    a, b = tmp_path / "a.jsonl", tmp_path / "b.jsonl"
+    data = _datums(10)
+    monkeypatch.setenv("PYRUHVRO_TPU_TRACE", str(a))
+    deserialize_array(data, SCHEMA, backend="host")
+    assert len(a.read_text().strip().splitlines()) == 1
+    telemetry.reset()
+    monkeypatch.setenv("PYRUHVRO_TPU_TRACE", str(b))
+    deserialize_array(data, SCHEMA, backend="host")
+    assert len(b.read_text().strip().splitlines()) == 1
+    assert len(a.read_text().strip().splitlines()) == 1  # untouched
+
+
+# ---------------------------------------------------------------------------
+# Prometheus exporter (satellite)
+# ---------------------------------------------------------------------------
+
+
+def test_prometheus_histogram_series_scrapeable():
+    data = _datums(50)
+    for _ in range(3):
+        deserialize_array(data, SCHEMA, backend="host")
+    text = telemetry.prometheus()
+    assert "# HELP " in text
+    fam = "pyruhvro_tpu_api_deserialize_array_seconds"
+    bucket_lines = [ln for ln in text.splitlines()
+                    if ln.startswith(fam + "_bucket{")]
+    assert bucket_lines, text
+    counts = [float(ln.rsplit(" ", 1)[1]) for ln in bucket_lines]
+    assert counts == sorted(counts)  # cumulative
+    assert bucket_lines[-1].startswith(fam + '_bucket{le="+Inf"}')
+    assert f"{fam}_count 3" in text
+    assert f"{fam}_sum " in text
+
+
+def test_prometheus_legacy_snapshot_without_buckets():
+    """A snapshot saved before bucket arrays existed still exports a
+    valid (single +Inf bucket) histogram series."""
+    snap = {
+        "counters": {"x.y_s": 1.5},
+        "histograms": {"x.y_s": {"count": 4, "sum": 1.5, "p50": 0.1,
+                                 "p95": 0.5, "p99": 0.5}},
+    }
+    text = telemetry.prometheus(snap)
+    assert 'pyruhvro_tpu_x_y_seconds_bucket{le="+Inf"} 4' in text
+    assert "pyruhvro_tpu_x_y_seconds_count 4" in text
+
+
+# ---------------------------------------------------------------------------
+# report rendering + CLI error surface (satellite)
+# ---------------------------------------------------------------------------
+
+
+def test_render_report_native_prof_and_worker_sections():
+    data = {
+        "counters": {
+            "host.vm_s": 0.6,
+            "vm.op.string": 1000.0, "vm.op.string_s": 0.4,
+            "vm.op.long": 500.0, "vm.op.long_s": 0.17,
+            "pool.worker_rows": 800.0, "pool.worker_merges": 4.0,
+        },
+        "histograms": {},
+        "flight_records": 3,
+    }
+    out = telemetry.render_report(data)
+    assert "native profiler" in out
+    assert "string" in out and "hits" in out
+    assert "% of host.vm_s" in out
+    assert "pool workers" in out
+    assert "flight recorder: 3" in out
+
+
+def test_cli_friendly_errors(tmp_path, capsys):
+    from pyruhvro_tpu.runtime.telemetry import main
+
+    # missing file
+    assert main(["report", str(tmp_path / "nope.json")]) == 2
+    err = capsys.readouterr().err
+    assert "error:" in err and "usage:" in err
+    # malformed JSON
+    bad = tmp_path / "bad.json"
+    bad.write_text("{not json")
+    assert main(["report", str(bad)]) == 2
+    assert "not valid JSON" in capsys.readouterr().err
+    # valid JSON, wrong shape (a list)
+    lst = tmp_path / "list.json"
+    lst.write_text("[1, 2, 3]")
+    assert main(["report", str(lst)]) == 2
+    assert "not a snapshot object" in capsys.readouterr().err
+    # a dict with none of the expected keys
+    empty = tmp_path / "empty.json"
+    empty.write_text('{"foo": 1}')
+    assert main(["report", str(empty)]) == 2
+    assert main(["prom", str(empty)]) == 2
+
+
+def test_cli_renders_profiler_keys(tmp_path, capsys):
+    from pyruhvro_tpu.runtime.telemetry import main
+
+    snap = tmp_path / "snap.json"
+    snap.write_text(json.dumps({
+        "counters": {"host.vm_s": 0.2, "vm.op.int": 10.0,
+                     "vm.op.int_s": 0.19},
+        "histograms": {},
+    }))
+    assert main(["report", str(snap)]) == 0
+    out = capsys.readouterr().out
+    assert "native profiler" in out
+
+
+# ---------------------------------------------------------------------------
+# perf-regression gate
+# ---------------------------------------------------------------------------
+
+
+def _load_perf_gate():
+    spec = importlib.util.spec_from_file_location(
+        "perf_gate", os.path.join(REPO, "scripts", "perf_gate.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+BASELINE = os.path.join(REPO, "PERF_BASELINE.json")
+
+
+def test_perf_gate_passes_on_committed_baseline():
+    """Acceptance: exit 0 when the measured medians ARE the baseline."""
+    gate = _load_perf_gate()
+    rc = gate.main(["--details", BASELINE, "--baseline", BASELINE,
+                    "--no-trajectory"])
+    assert rc == 0
+
+
+def test_perf_gate_fails_on_injected_regression(tmp_path):
+    """Acceptance: a synthetic 20% median regression exits non-zero."""
+    gate = _load_perf_gate()
+    base = json.load(open(BASELINE))
+    slow = {"cases": {k: dict(v, median_s=v["median_s"] * 1.2)
+                      for k, v in base["cases"].items()}}
+    details = tmp_path / "slow.json"
+    details.write_text(json.dumps(slow))
+    rc = gate.main(["--details", str(details), "--baseline", BASELINE,
+                    "--no-trajectory"])
+    assert rc == 1
+
+
+def test_perf_gate_improvement_passes(tmp_path):
+    gate = _load_perf_gate()
+    base = json.load(open(BASELINE))
+    fast = {"cases": {k: dict(v, median_s=v["median_s"] * 0.5)
+                      for k, v in base["cases"].items()}}
+    details = tmp_path / "fast.json"
+    details.write_text(json.dumps(fast))
+    rc = gate.main(["--details", str(details), "--baseline", BASELINE,
+                    "--no-trajectory"])
+    assert rc == 0
+
+
+def test_perf_gate_usage_errors(tmp_path):
+    gate = _load_perf_gate()
+    # unreadable baseline
+    rc = gate.main(["--baseline", str(tmp_path / "nope.json"),
+                    "--details", BASELINE, "--no-trajectory"])
+    assert rc == 2
+    # details with nothing comparable
+    junk = tmp_path / "junk.json"
+    junk.write_text("[]")
+    rc = gate.main(["--details", str(junk), "--baseline", BASELINE,
+                    "--no-trajectory"])
+    assert rc == 2
+
+
+def test_perf_gate_appends_trajectory(tmp_path):
+    gate = _load_perf_gate()
+    traj = tmp_path / "traj.jsonl"
+    rc = gate.main(["--details", BASELINE, "--baseline", BASELINE,
+                    "--trajectory", str(traj)])
+    assert rc == 0
+    lines = traj.read_text().strip().splitlines()
+    assert len(lines) == 1
+    entry = json.loads(lines[0])
+    assert entry["kind"] == "perf_gate"
+    assert entry["pass"] is True
+    assert entry["cases"]

@@ -225,6 +225,9 @@ def test_prometheus_export_parses_line_by_line():
     assert text
     buckets = {}
     for line in text.splitlines():
+        if line.startswith("# HELP "):
+            assert re.match(r"^# HELP [a-zA-Z_][a-zA-Z0-9_]* \S.*$", line), line
+            continue
         if line.startswith("# TYPE "):
             assert re.match(
                 r"^# TYPE [a-zA-Z_][a-zA-Z0-9_]* (counter|histogram)$", line
