@@ -24,6 +24,8 @@ from .api import (
 )
 from .gate import device_supported, host_supported, is_supported
 from .runtime import metrics
+from .runtime.quarantine import QuarantinedRecord
+from .runtime.quarantine import last as last_quarantine
 # bound from runtime (not the .telemetry CLI shim): `-m
 # pyruhvro_tpu.telemetry` must find its module un-imported, or runpy
 # warns about double execution; both names expose the same functions
@@ -41,6 +43,8 @@ __all__ = [
     "is_supported",
     "host_supported",
     "device_supported",
+    "last_quarantine",
+    "QuarantinedRecord",
     "parse_schema",
     "to_arrow_schema",
     "metrics",

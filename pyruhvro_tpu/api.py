@@ -12,9 +12,13 @@
 * ``serialize_record_batch(batch, schema, num_chunks)`` → ``list[BinaryArray]``
 * ``serialize_record_batch_spawn`` — ditto.
 
-One addition over the reference (the BASELINE.json north star):
+Additions over the reference (the BASELINE.json north star):
 ``backend=`` on every function — ``"auto"`` (default), ``"tpu"`` (force
-device; errors if unsupported), ``"host"`` (force the host path).
+device; errors if unsupported), ``"host"`` (force the host path) — and
+the error-policy layer: ``on_error="raise" | "skip" | "null"`` plus
+``return_errors=True`` on every function, with quarantined rows
+reported through :func:`pyruhvro_tpu.last_quarantine` (see the
+"error-policy layer" section below).
 
 The host path itself is two-tiered, mirroring the reference's
 fast/fallback split (``deserialize.rs:26-29``): schemas in the fast
@@ -38,9 +42,15 @@ import pyarrow as pa
 
 from .gate import device_supported
 from .ops import UnsupportedOnDevice
-from .fallback.decoder import compile_reader, decode_to_record_batch
+from .fallback.decoder import (
+    compile_reader,
+    decode_pairs_tolerant,
+    decode_to_record_batch,
+    rows_to_record_batch,
+)
 from .fallback.encoder import compile_encoder_plan, encode_record_batch
-from .runtime import metrics, telemetry
+from .fallback.io import MalformedAvro, max_datum_bytes, shift_malformed
+from .runtime import metrics, quarantine, telemetry
 from .runtime.chunking import bounds_rows, chunk_bounds
 from .runtime.pool import map_chunks, map_chunks_proc, pool_mode
 from .schema.cache import SchemaEntry, get_or_parse_schema
@@ -256,6 +266,291 @@ def _check_backend(backend: str) -> str:
     return backend
 
 
+def _check_on_error(on_error: str) -> str:
+    if on_error not in ("raise", "skip", "null"):
+        raise ValueError(
+            f"on_error must be 'raise', 'skip' or 'null', got {on_error!r}"
+        )
+    return on_error
+
+
+# -- error-policy layer (on_error="skip"/"null") ---------------------------
+#
+# The tolerant engine behind every public API call's ``on_error`` knob.
+# Strategy is optimistic-fast-path: the batch decodes on its normal tier
+# at full speed; only a MalformedAvro pays extra work. The native VM
+# reports the FIRST bad row, so the resume loop decodes the known-good
+# prefix in one pass, quarantines the offender, and re-enters the same
+# tier on the remaining slice (single-VM-thread retries so the VM stops
+# AT the error instead of decoding the whole tail per attempt — total
+# work stays ~2 passes regardless of how many rows are poisoned). The
+# device tier's error pass yields the FULL per-lane error-bit row mask
+# (``MalformedAvro.indices``), so all offenders quarantine at once and
+# the survivors decode in one extra launch. The pure-Python oracle is
+# the per-record last resort for anything that fails without a usable
+# row index. ``on_error="raise"`` (the default) never enters any of
+# this — behavior and cost are exactly the pre-policy fast path.
+
+
+def _enforce_max_datum(data) -> None:
+    """The PYRUHVRO_TPU_MAX_DATUM_BYTES ceiling for ``on_error="raise"``
+    paths on every tier. Free when the knob is unset (one env read)."""
+    limit = max_datum_bytes()
+    if not limit:
+        return
+    for j, d in enumerate(data):
+        if len(d) > limit:
+            raise MalformedAvro(
+                f"record {j}: datum of {len(d)} bytes exceeds "
+                f"PYRUHVRO_TPU_MAX_DATUM_BYTES={limit}",
+                index=j, err_name="datum_too_large", tier="policy",
+            )
+
+
+def _row_nullable(ir) -> bool:
+    """True when every top-level field admits null — the schemas where
+    ``on_error="null"`` can keep the row count (bad rows become all-null
+    rows); anywhere else the policy degrades to skip, counted."""
+    from .schema.model import Union as _Union
+
+    return all(
+        isinstance(f.type, _Union) and f.type.null_index is not None
+        for f in ir.fields
+    )
+
+
+def _concat(batches: List[pa.RecordBatch], entry) -> pa.RecordBatch:
+    batches = [b for b in batches if b.num_rows]
+    if not batches:
+        return rows_to_record_batch([], entry.ir, entry.arrow_schema)
+    if len(batches) == 1:
+        return batches[0]
+    if hasattr(pa, "concat_batches"):
+        return pa.concat_batches(batches)
+    out = pa.Table.from_batches(batches).combine_chunks().to_batches()
+    return out[0] if out else batches[0]
+
+
+def _oracle_pairs(pairs, entry, quar) -> pa.RecordBatch:
+    """Per-record last resort: every pair through the Python oracle,
+    offenders into ``quar`` with their caller-assigned global indices.
+
+    Covers BOTH poison classes: wire-level (stage 1 — captured per
+    record by the reader) and value-level (stage 2 — wire-valid datums
+    whose VALUES cannot build, e.g. invalid uuid text or a decimal
+    beyond its declared precision; isolated by bisecting the Arrow
+    build, which raises ValueError/ArrowInvalid without a row index)."""
+    rows, errs = decode_pairs_tolerant(
+        pairs, entry.ir, _host_reader(entry)
+    )
+    for gi, datum, name in errs:
+        quar.append(quarantine.QuarantinedRecord(
+            gi, datum, name, "fallback"))
+    bad = {gi for gi, _d, _n in errs}
+    triples = [
+        (gi, d, v)
+        for (gi, d), v in zip(
+            [pr for pr in pairs if pr[0] not in bad], rows)
+    ]
+
+    def build(tris):
+        return rows_to_record_batch(
+            [v for _, _, v in tris], entry.ir, entry.arrow_schema)
+
+    try:
+        return build(triples)
+    except (ValueError, OverflowError):
+        pass
+
+    def bisect(tris):
+        if not tris:
+            return []
+        try:
+            return [build(tris)]
+        except (ValueError, OverflowError) as e:
+            if len(tris) == 1:
+                gi, d, _v = tris[0]
+                quar.append(quarantine.QuarantinedRecord(
+                    gi, d, "bad_value", "fallback"))
+                return []
+            mid = len(tris) // 2
+            return bisect(tris[:mid]) + bisect(tris[mid:])
+
+    return _concat(bisect(triples), entry)
+
+
+def _tolerant_decode(tier, impl, entry, data, base):
+    """Decode ``data`` on its routed tier under a tolerant policy →
+    ``(batch_of_survivors, quarantine_entries)``; surviving rows keep
+    their relative order, entries carry GLOBAL indices (``base`` +
+    position)."""
+    pairs = [(base + j, d) for j, d in enumerate(data)]
+    quar: List[quarantine.QuarantinedRecord] = []
+    limit = max_datum_bytes()
+    if limit:
+        keep = []
+        for gi, d in pairs:
+            if len(d) > limit:
+                quar.append(quarantine.QuarantinedRecord(
+                    gi, d, "datum_too_large", "policy"))
+            else:
+                keep.append((gi, d))
+        pairs = keep
+    if tier == "fallback" or impl is None:
+        batch = _oracle_pairs(pairs, entry, quar)
+        return batch, quar
+
+    def tier_decode(items, first):
+        if tier == "native" and not first:
+            # one VM thread: the shard runner stops AT the first bad
+            # record, so each resume attempt costs only the distance to
+            # the next offender instead of a full pass over the tail
+            return impl.decode(items, nthreads=1)
+        return impl.decode(items)
+
+    parts: List[pa.RecordBatch] = []
+    first = True
+    budget = 2 * len(pairs) + 16  # hard stop against no-progress loops
+    while pairs:
+        budget -= 1
+        if budget <= 0:
+            parts.append(_oracle_pairs(pairs, entry, quar))
+            break
+        items = [d for _, d in pairs]
+        try:
+            parts.append(tier_decode(items, first))
+            pairs = []
+            break
+        except MalformedAvro as e:
+            first = False
+            idxs = getattr(e, "indices", None)
+            k = getattr(e, "index", None)
+            if idxs and all(0 <= i < len(pairs) for i, _ in idxs):
+                # device error pass: the full row mask in one shot
+                names = {}
+                for i, nm in idxs:
+                    names.setdefault(i, nm)
+                for i in sorted(names):
+                    gi, d = pairs[i]
+                    quar.append(quarantine.QuarantinedRecord(
+                        gi, d, names[i] or "malformed", e.tier or tier))
+                pairs = [p for j, p in enumerate(pairs)
+                         if j not in names]
+            elif k is not None and 0 <= k < len(pairs):
+                # first-bad-index tiers: prefix is known good — decode
+                # it in one pass, drop the offender, resume on the tail
+                if k:
+                    try:
+                        parts.append(tier_decode(items[:k], True))
+                    except Exception:
+                        parts.append(
+                            _oracle_pairs(pairs[:k], entry, quar))
+                gi, d = pairs[k]
+                quar.append(quarantine.QuarantinedRecord(
+                    gi, d, e.err_name or "malformed", e.tier or tier))
+                pairs = pairs[k + 1:]
+            else:
+                parts.append(_oracle_pairs(pairs, entry, quar))
+                break
+        except Exception:
+            # non-wire failure (capacity convergence, backend fault):
+            # the oracle serves the remainder per record
+            parts.append(_oracle_pairs(pairs, entry, quar))
+            break
+    return _concat(parts, entry), quar
+
+
+_ENC_ROW_ERRORS = (OverflowError, ValueError)  # decimal misfit, range,
+# per-row value errors — NOT BatchTooLarge (a capacity condition that
+# must keep propagating so callers split, exactly as under "raise")
+
+
+def _encode_bisect(encode_fn, batch, base, quar, tier):
+    """Isolate encode offenders by recursive halving (encode errors
+    carry no row index): good halves encode whole, single-row failures
+    quarantine (``datum=None`` — a row that never encoded has no wire
+    bytes). Cost O(n) when clean, O(bad × log n) extra per offender."""
+    try:
+        return [encode_fn(batch)]
+    except _ENC_ROW_ERRORS as e:
+        if batch.num_rows <= 1:
+            if batch.num_rows == 1:
+                quar.append(quarantine.QuarantinedRecord(
+                    base, None,
+                    "encode_" + type(e).__name__.lower(), tier))
+            return []
+        mid = batch.num_rows // 2
+        return (
+            _encode_bisect(encode_fn, batch.slice(0, mid), base, quar,
+                           tier)
+            + _encode_bisect(encode_fn, batch.slice(mid), base + mid,
+                             quar, tier)
+        )
+
+
+def _tolerant_encode(tier, impl, entry, batch, policy):
+    """Encode under a tolerant policy → ``(binary_array, entries)``.
+    Optimistic: the clean case is ONE normal encode. Under ``"null"``
+    on an all-nullable schema the offending rows are re-encoded as
+    all-null rows so the output row count matches the input."""
+    if tier != "fallback" and impl is not None:
+        encode_fn = impl.encode
+    else:
+        plan = entry.get_extra(
+            "host_encode_plan", lambda: compile_encoder_plan(entry.ir)
+        )
+
+        def encode_fn(b):
+            return pa.array(
+                encode_record_batch(b, entry.ir, plan), pa.binary())
+
+    quar: List[quarantine.QuarantinedRecord] = []
+    arrays = _encode_bisect(encode_fn, batch, 0, quar, tier)
+    if quar and policy == "null" and _row_nullable(entry.ir):
+        bad = {e.index for e in quar}
+        indices = [None if j in bad else j
+                   for j in range(batch.num_rows)]
+        try:
+            repaired = batch.take(pa.array(indices, type=pa.int64()))
+            return encode_fn(repaired), quar
+        except _ENC_ROW_ERRORS + (pa.lib.ArrowNotImplementedError,
+                                  pa.lib.ArrowInvalid):
+            metrics.inc("encode.null_fallback_skip")
+    if not arrays:
+        return pa.array([], pa.binary()), quar
+    return (arrays[0] if len(arrays) == 1
+            else pa.concat_arrays(arrays)), quar
+
+
+def _apply_null_policy(batch, entries, base, n, policy, entry):
+    """Under ``on_error="null"`` re-inflate the survivor batch to ``n``
+    rows with all-null rows at the quarantined positions (schemas whose
+    top-level fields are all nullable); otherwise the skip shape."""
+    if policy != "null" or not entries:
+        return batch
+    if not _row_nullable(entry.ir):
+        metrics.inc("decode.null_unsupported_schema")
+        return batch
+    bad = {e.index - base for e in entries}
+    indices: List[Optional[int]] = []
+    k = 0
+    for j in range(n):
+        if j in bad:
+            indices.append(None)
+        else:
+            indices.append(k)
+            k += 1
+    if k != batch.num_rows:  # survivor accounting mismatch: keep skip
+        return batch
+    try:
+        return batch.take(pa.array(indices, type=pa.int64()))
+    except (pa.lib.ArrowNotImplementedError, pa.lib.ArrowInvalid):
+        # e.g. sparse-union columns predate take support: degrade to
+        # skip rather than fail the tolerant call
+        metrics.inc("decode.null_fallback_skip")
+        return batch
+
+
 # -- opt-in process-pool chunk fan-out (PYRUHVRO_TPU_POOL=process) ---------
 #
 # Host-tier chunked calls can fan chunks to a spawn-based process pool:
@@ -269,54 +564,115 @@ def _check_backend(backend: str) -> str:
 
 
 def _proc_decode_task(payload):
-    schema, data = payload
+    schema, data, base, on_error = payload
     with telemetry.worker_scope("pool.worker", rows=len(data),
                                 op="decode") as w:
-        batch = deserialize_array(data, schema, backend="host")
+        try:
+            if on_error == "raise":
+                batch = deserialize_array(data, schema, backend="host")
+                errs = []
+            else:
+                batch, errs = deserialize_array(
+                    data, schema, backend="host", on_error=on_error,
+                    return_errors=True,
+                )
+        except MalformedAvro as e:
+            # the worker sees a chunk slice: re-base to the call's
+            # GLOBAL row index before the error crosses the process
+            # boundary (__reduce__ keeps the structured fields)
+            raise shift_malformed(e, base) from None
+    if errs:
+        w.payload["quarantine"] = [
+            (q.index + base, q.datum, q.error, q.tier) for q in errs
+        ]
     return batch, w.payload
 
 
 def _proc_encode_task(payload):
-    schema, batch = payload
+    schema, batch, base, on_error = payload
     with telemetry.worker_scope("pool.worker", rows=batch.num_rows,
                                 op="encode") as w:
-        [arr] = serialize_record_batch(batch, schema, 1, backend="host")
+        if on_error == "raise":
+            [arr] = serialize_record_batch(batch, schema, 1, backend="host")
+            errs = []
+        else:
+            [arr], errs = serialize_record_batch(
+                batch, schema, 1, backend="host", on_error=on_error,
+                return_errors=True,
+            )
+    if errs:
+        w.payload["quarantine"] = [
+            (q.index + base, q.datum, q.error, q.tier) for q in errs
+        ]
     return arr, w.payload
 
 
 def _proc_map(task, payloads, rows):
     """Fan out on the process pool; None = fall back to the thread path
-    (counted): a pool failure must degrade, never fail the call. A
-    worker's own decode/encode error re-raises from the thread retry
-    with its exact message."""
+    (counted): a pool INFRASTRUCTURE failure must degrade, never fail
+    the call. A worker that died on a poison datum is not an
+    infrastructure failure: its MalformedAvro re-raises directly — with
+    the worker's original error name and the GLOBAL row index
+    (``_proc_decode_task`` re-bases before pickling) — and counts as
+    ``pool.worker_malformed``, not ``pool.process_fallback``."""
     try:
         return map_chunks_proc(task, payloads, rows=rows)
+    except MalformedAvro:
+        metrics.inc("pool.worker_malformed")
+        raise
     except Exception:
         metrics.inc("pool.process_fallback")
         return None
 
 
 def deserialize_array(
-    data: Sequence[bytes], schema: str, *, backend: str = "auto"
+    data: Sequence[bytes], schema: str, *, backend: str = "auto",
+    on_error: str = "raise", return_errors: bool = False,
 ) -> pa.RecordBatch:
     """Decode Avro datums into a single RecordBatch
-    (≙ ``deserialize_array``, ``src/lib.rs:56-71``)."""
+    (≙ ``deserialize_array``, ``src/lib.rs:56-71``).
+
+    ``on_error``: ``"raise"`` (default — a corrupt datum aborts the
+    call, exact pre-policy behavior), ``"skip"`` (corrupt rows are
+    dropped and quarantined — see :func:`pyruhvro_tpu.last_quarantine`),
+    or ``"null"`` (quarantined AND, where every top-level field is
+    nullable, replaced by an all-null row so the row count is
+    preserved). ``return_errors=True`` returns
+    ``(batch, [QuarantinedRecord, ...])`` instead of the bare batch."""
     _check_backend(backend)
+    _check_on_error(on_error)
     entry = get_or_parse_schema(schema)
     with telemetry.root_span("api.deserialize_array", rows=len(data),
                              backend=backend, schema=entry.fingerprint):
         tier, impl, reason = _route(entry, backend, len(data))
         telemetry.set_route(tier, reason)
-        if tier != "fallback":
-            return impl.decode(data)
-        with telemetry.phase("fallback.decode_s", rows=len(data)):
-            return decode_to_record_batch(
-                data, entry.ir, entry.arrow_schema, _host_reader(entry)
-            )
+        if on_error == "raise":
+            _enforce_max_datum(data)
+            if tier != "fallback":
+                batch = impl.decode(data)
+            else:
+                with telemetry.phase("fallback.decode_s", rows=len(data)):
+                    batch = decode_to_record_batch(
+                        data, entry.ir, entry.arrow_schema,
+                        _host_reader(entry),
+                    )
+            return (batch, []) if return_errors else batch
+        with quarantine.collecting() as quar:
+            with telemetry.phase("decode.tolerant_s", rows=len(data),
+                                 tier=tier):
+                batch, entries = _tolerant_decode(
+                    tier, impl, entry, data, 0)
+            quar.extend(entries)
+            batch = _apply_null_policy(
+                batch, entries, 0, len(data), on_error, entry)
+            quarantine.publish(quar, on_error)
+        return (batch, quar) if return_errors else batch
 
 
 def deserialize_array_threaded(
-    data: Sequence[bytes], schema: str, num_chunks: int, *, backend: str = "auto"
+    data: Sequence[bytes], schema: str, num_chunks: int, *,
+    backend: str = "auto", on_error: str = "raise",
+    return_errors: bool = False,
 ) -> List[pa.RecordBatch]:
     """Decode in ``num_chunks`` chunks → one RecordBatch per chunk
     (≙ ``deserialize_array_threaded``, ``src/lib.rs:73-89``).
@@ -325,8 +681,14 @@ def deserialize_array_threaded(
     threads: with multiple devices attached, chunks are decoded by
     ``shard_map`` over the mesh's ``"chunks"`` axis in one launch
     (``parallel/sharded.py``); on a single chip the whole input is
-    decoded in one fused launch and sliced per chunk."""
+    decoded in one fused launch and sliced per chunk.
+
+    ``on_error``/``return_errors``: see :func:`deserialize_array`.
+    Chunk boundaries are computed on the INPUT rows; under ``"skip"``
+    a chunk's batch holds its surviving rows (``"null"`` preserves the
+    per-chunk row count on all-nullable schemas)."""
     _check_backend(backend)
+    _check_on_error(on_error)
     entry = get_or_parse_schema(schema)
     bounds = chunk_bounds(len(data), num_chunks)
     with telemetry.root_span("api.deserialize_array_threaded",
@@ -334,41 +696,118 @@ def deserialize_array_threaded(
                              backend=backend, schema=entry.fingerprint):
         tier, impl, reason = _route(entry, backend, len(data))
         telemetry.set_route(tier, reason)
-        if tier != "device" and len(bounds) > 1 and pool_mode() == "process":
-            out = _proc_map(
-                _proc_decode_task,
-                [(schema, list(data[a:b])) for a, b in bounds],
-                rows=lambda p: len(p[1]),
-            )
-            if out is not None:
-                return out
-        if tier != "fallback":
-            return impl.decode_threaded(data, num_chunks)
-        ir, arrow, reader = entry.ir, entry.arrow_schema, _host_reader(entry)
-
-        def decode_chunk(ab):
-            with telemetry.phase("fallback.decode_s", rows=ab[1] - ab[0]):
-                return decode_to_record_batch(
-                    data[ab[0]:ab[1]], ir, arrow, reader
+        if on_error == "raise":
+            _enforce_max_datum(data)
+            if (tier != "device" and len(bounds) > 1
+                    and pool_mode() == "process"):
+                out = _proc_map(
+                    _proc_decode_task,
+                    [(schema, list(data[a:b]), a, "raise")
+                     for a, b in bounds],
+                    rows=lambda p: len(p[1]),
                 )
+                if out is not None:
+                    return (out, []) if return_errors else out
+            if tier != "fallback":
+                out = impl.decode_threaded(data, num_chunks)
+                return (out, []) if return_errors else out
+            ir, arrow = entry.ir, entry.arrow_schema
+            reader = _host_reader(entry)
 
-        return map_chunks(decode_chunk, bounds, rows=bounds_rows)
+            def decode_chunk(ab):
+                with telemetry.phase("fallback.decode_s",
+                                     rows=ab[1] - ab[0]):
+                    return decode_to_record_batch(
+                        data[ab[0]:ab[1]], ir, arrow, reader,
+                        index_base=ab[0],
+                    )
+
+            out = map_chunks(decode_chunk, bounds, rows=bounds_rows)
+            return (out, []) if return_errors else out
+        # tolerant policies: per-chunk isolation so one poisoned chunk
+        # never forces another chunk off its fast path
+        with quarantine.collecting() as quar:
+            out = None
+            if (tier != "device" and len(bounds) > 1
+                    and pool_mode() == "process"):
+                # workers apply the policy on their own slice and ship
+                # quarantine entries back with the telemetry payload
+                # (merged into `quar` by telemetry.merge_worker)
+                out = _proc_map(
+                    _proc_decode_task,
+                    [(schema, list(data[a:b]), a, on_error)
+                     for a, b in bounds],
+                    rows=lambda p: len(p[1]),
+                )
+            if out is None:
+                # a failed pool fan-out may have merged partial worker
+                # results: the paths below redecode every chunk, so
+                # start the collector clean
+                quar.clear()
+                quarantine.reset_merged()
+                # optimistic fast path: a clean batch takes EXACTLY the
+                # "raise" execution shape (one fused/sharded launch on
+                # the device tier, the VM's per-chunk mode on native) —
+                # only a failure drops to per-chunk isolation below.
+                # With the MAX_DATUM_BYTES knob set, oversized datums
+                # must quarantine even though the tiers would decode
+                # them, so the screening per-chunk path serves instead.
+                if tier != "fallback" and not max_datum_bytes():
+                    try:
+                        out = impl.decode_threaded(data, num_chunks)
+                    except Exception:
+                        out = None
+            if out is None:
+                def tolerant_chunk(ab):
+                    a, b = ab
+                    with telemetry.phase("decode.tolerant_s",
+                                         rows=b - a, tier=tier):
+                        batch, entries = _tolerant_decode(
+                            tier, impl, entry, data[a:b], a)
+                    quar.extend(entries)
+                    return _apply_null_policy(
+                        batch, entries, a, b - a, on_error, entry)
+
+                if tier == "device":
+                    # the device decode is internally parallel (mesh /
+                    # VM shards); host-thread fan-out adds nothing
+                    out = [tolerant_chunk(ab) for ab in bounds]
+                else:
+                    out = map_chunks(tolerant_chunk, bounds,
+                                     rows=bounds_rows)
+            quarantine.publish(quar, on_error)
+        return (out, quar) if return_errors else out
 
 
 def deserialize_array_threaded_spawn(
-    data: Sequence[bytes], schema: str, num_chunks: int, *, backend: str = "auto"
+    data: Sequence[bytes], schema: str, num_chunks: int, *,
+    backend: str = "auto", on_error: str = "raise",
+    return_errors: bool = False,
 ) -> List[pa.RecordBatch]:
     """Signature-parity alias of :func:`deserialize_array_threaded`
     (≙ ``src/lib.rs:108-128``; thread-pool flavor is a host-side detail)."""
-    return deserialize_array_threaded(data, schema, num_chunks, backend=backend)
+    return deserialize_array_threaded(
+        data, schema, num_chunks, backend=backend, on_error=on_error,
+        return_errors=return_errors,
+    )
 
 
 def serialize_record_batch(
-    batch: pa.RecordBatch, schema: str, num_chunks: int, *, backend: str = "auto"
+    batch: pa.RecordBatch, schema: str, num_chunks: int, *,
+    backend: str = "auto", on_error: str = "raise",
+    return_errors: bool = False,
 ) -> List[pa.Array]:
     """Encode a RecordBatch into Avro datums, one BinaryArray per chunk
-    (≙ ``serialize_record_batch``, ``src/lib.rs:91-106``)."""
+    (≙ ``serialize_record_batch``, ``src/lib.rs:91-106``).
+
+    ``on_error``: ``"raise"`` (default, pre-policy behavior), ``"skip"``
+    (rows whose values cannot encode — e.g. a decimal that does not fit
+    its fixed size — are dropped and quarantined with ``datum=None``),
+    or ``"null"`` (on all-nullable schemas the offending rows encode as
+    all-null rows, preserving the row count). Under ``"skip"`` the
+    chunked return re-chunks over the SURVIVING rows."""
     _check_backend(backend)
+    _check_on_error(on_error)
     entry = get_or_parse_schema(schema)
     if isinstance(batch, pa.Table):
         batches = batch.combine_chunks().to_batches()
@@ -384,34 +823,78 @@ def serialize_record_batch(
         tier, impl, reason = _route(entry, backend, batch.num_rows,
                                     need_encode=True)
         telemetry.set_route(tier, reason)
-        if tier != "device" and len(bounds) > 1 and pool_mode() == "process":
-            out = _proc_map(
-                _proc_encode_task,
-                [(schema, batch.slice(a, b - a)) for a, b in bounds],
-                rows=lambda p: p[1].num_rows,
-            )
-            if out is not None:
-                return out
-        if tier != "fallback":
-            return impl.encode_threaded(batch, num_chunks)
-        ir = entry.ir
-        plan = entry.get_extra(
-            "host_encode_plan", lambda: compile_encoder_plan(ir)
-        )
-
-        def encode_chunk(ab):
-            with telemetry.phase("fallback.encode_s", rows=ab[1] - ab[0]):
-                datums = encode_record_batch(
-                    batch.slice(ab[0], ab[1] - ab[0]), ir, plan
+        if on_error == "raise":
+            if (tier != "device" and len(bounds) > 1
+                    and pool_mode() == "process"):
+                out = _proc_map(
+                    _proc_encode_task,
+                    [(schema, batch.slice(a, b - a), a, "raise")
+                     for a, b in bounds],
+                    rows=lambda p: p[1].num_rows,
                 )
-                return pa.array(datums, pa.binary())
+                if out is not None:
+                    return (out, []) if return_errors else out
+            if tier != "fallback":
+                out = impl.encode_threaded(batch, num_chunks)
+                return (out, []) if return_errors else out
+            ir = entry.ir
+            plan = entry.get_extra(
+                "host_encode_plan", lambda: compile_encoder_plan(ir)
+            )
 
-        return map_chunks(encode_chunk, bounds, rows=bounds_rows)
+            def encode_chunk(ab):
+                with telemetry.phase("fallback.encode_s",
+                                     rows=ab[1] - ab[0]):
+                    datums = encode_record_batch(
+                        batch.slice(ab[0], ab[1] - ab[0]), ir, plan
+                    )
+                    return pa.array(datums, pa.binary())
+
+            out = map_chunks(encode_chunk, bounds, rows=bounds_rows)
+            return (out, []) if return_errors else out
+        with quarantine.collecting() as quar:
+            out = None
+            if (tier != "device" and len(bounds) > 1
+                    and pool_mode() == "process"):
+                out = _proc_map(
+                    _proc_encode_task,
+                    [(schema, batch.slice(a, b - a), a, on_error)
+                     for a, b in bounds],
+                    rows=lambda p: p[1].num_rows,
+                )
+                if out is not None and quar:
+                    # per-input-chunk survivor arrays → the documented
+                    # shape: ONE array re-chunked over surviving rows
+                    # (identical to the thread path's return)
+                    whole = pa.concat_arrays(out)
+                    out = [
+                        whole.slice(a, b - a)
+                        for a, b in chunk_bounds(len(whole), num_chunks)
+                    ]
+            if out is None:
+                quar.clear()
+                quarantine.reset_merged()
+                with telemetry.phase("encode.tolerant_s",
+                                     rows=batch.num_rows, tier=tier):
+                    arr, entries = _tolerant_encode(
+                        tier, impl, entry, batch, on_error)
+                quar.extend(entries)
+                out = [
+                    arr.slice(a, b - a)
+                    for a, b in chunk_bounds(len(arr), num_chunks)
+                ]
+            quarantine.publish(quar, on_error, op="encode")
+        return (out, quar) if return_errors else out
 
 
 def serialize_record_batch_spawn(
-    batch: pa.RecordBatch, schema: str, num_chunks: int, *, backend: str = "auto"
+    batch: pa.RecordBatch, schema: str, num_chunks: int, *,
+    backend: str = "auto", on_error: str = "raise",
+    return_errors: bool = False,
 ) -> List[pa.Array]:
     """Signature-parity alias of :func:`serialize_record_batch`
     (≙ ``src/lib.rs:130-147``)."""
-    return serialize_record_batch(batch, schema, num_chunks, backend=backend)
+    return serialize_record_batch(
+        batch, schema, num_chunks, backend=backend, on_error=on_error,
+        return_errors=return_errors,
+    )

@@ -24,8 +24,10 @@ decimal→unscaled int.
 from __future__ import annotations
 
 import decimal
+import os
+import threading
 import uuid as _uuid
-from typing import Callable, List, Sequence
+from typing import Callable, List, Sequence, Tuple
 
 import numpy as np
 import pyarrow as pa
@@ -42,23 +44,77 @@ from ..schema.model import (
 )
 from ..schema.arrow_map import to_arrow_field, to_arrow_schema
 from .io import (
+    MAX_ZERO_WIDTH_ITEMS,
     MalformedAvro,
+    max_datum_bytes,
     read_bool,
     read_bytes,
     read_double,
     read_float,
     read_long,
+    shift_malformed,
 )
 
-__all__ = ["compile_reader", "decode_records", "MalformedAvro"]
+__all__ = [
+    "compile_reader",
+    "decode_records",
+    "decode_records_tolerant",
+    "decode_pairs_tolerant",
+    "rows_to_record_batch",
+    "MalformedAvro",
+]
 
 
 # ---------------------------------------------------------------------------
 # Stage 1: wire bytes → value tree
 # ---------------------------------------------------------------------------
 
-def compile_reader(t: AvroType) -> Callable:
+# Hostile-input guard: the walker's recursion depth is bounded by the
+# SCHEMA's nesting depth (the parser rejects recursive schemas), so the
+# cap is enforced once at compile time rather than per datum. Default 64
+# levels; PYRUHVRO_TPU_MAX_DEPTH overrides.
+_DEFAULT_MAX_DEPTH = 64
+
+
+def _max_depth() -> int:
+    try:
+        return int(
+            os.environ.get("PYRUHVRO_TPU_MAX_DEPTH", "")
+            or _DEFAULT_MAX_DEPTH
+        )
+    except ValueError:
+        return _DEFAULT_MAX_DEPTH
+
+
+# per-thread budget of zero-width array/map items for the datum being
+# decoded (reset per datum by decode_records / decode_records_tolerant):
+# null / empty-record items consume no wire bytes, so a claimed block
+# count is the ONE quantity the remaining-bytes bound cannot limit
+_zw_tls = threading.local()
+
+
+def _reset_zw_budget() -> None:
+    _zw_tls.budget = MAX_ZERO_WIDTH_ITEMS
+
+
+def _charge_zero_width(count: int) -> None:
+    left = getattr(_zw_tls, "budget", MAX_ZERO_WIDTH_ITEMS) - count
+    _zw_tls.budget = left
+    if left < 0:
+        raise MalformedAvro(
+            f"block claims more zero-width items than the per-datum cap "
+            f"({MAX_ZERO_WIDTH_ITEMS})",
+            err_name="zero_width_items",
+        )
+
+
+def compile_reader(t: AvroType, _depth: int = 0) -> Callable:
     """Build a ``reader(buf, pos) -> (value, pos)`` closure for ``t``."""
+    if _depth > _max_depth():
+        raise ValueError(
+            f"schema nesting depth exceeds the walker cap "
+            f"({_max_depth()}; PYRUHVRO_TPU_MAX_DEPTH overrides)"
+        )
     if isinstance(t, Primitive):
         name = t.name
         if name == "null":
@@ -86,7 +142,8 @@ def compile_reader(t: AvroType) -> Callable:
                 try:
                     return raw.decode("utf-8"), pos
                 except UnicodeDecodeError as e:
-                    raise MalformedAvro(f"invalid UTF-8 in string: {e}") from None
+                    raise MalformedAvro(f"invalid UTF-8 in string: {e}",
+                                        err_name="bad_utf8") from None
             return read_string
         raise NotImplementedError(name)
 
@@ -95,7 +152,7 @@ def compile_reader(t: AvroType) -> Callable:
         if t.logical == "decimal":
             def read_fixed_decimal(buf, pos):
                 if pos + size > len(buf):
-                    raise MalformedAvro("truncated fixed")
+                    raise MalformedAvro("truncated fixed", err_name="overrun")
                 return (
                     int.from_bytes(buf[pos : pos + size], "big", signed=True),
                     pos + size,
@@ -104,7 +161,7 @@ def compile_reader(t: AvroType) -> Callable:
 
         def read_fixed(buf, pos):
             if pos + size > len(buf):
-                raise MalformedAvro("truncated fixed")
+                raise MalformedAvro("truncated fixed", err_name="overrun")
             return bytes(buf[pos : pos + size]), pos + size
         return read_fixed
 
@@ -114,12 +171,13 @@ def compile_reader(t: AvroType) -> Callable:
         def read_enum(buf, pos):
             idx, pos = read_long(buf, pos)
             if not 0 <= idx < n:
-                raise MalformedAvro(f"enum index {idx} out of range 0..{n}")
+                raise MalformedAvro(f"enum index {idx} out of range 0..{n}",
+                                    err_name="bad_enum")
             return symbols[idx], pos
         return read_enum
 
     if isinstance(t, Array):
-        item_reader = compile_reader(t.items)
+        item_reader = compile_reader(t.items, _depth + 1)
         def read_array(buf, pos):
             out = []
             while True:
@@ -131,13 +189,21 @@ def compile_reader(t: AvroType) -> Callable:
                     # byte-size long we can skip over (fast_decode.rs:689-700)
                     count = -count
                     _, pos = read_long(buf, pos)
-                for _ in range(count):
+                for k in range(count):
+                    prev = pos
                     v, pos = item_reader(buf, pos)
                     out.append(v)
+                    if k == 0 and pos == prev:
+                        # zero-width items (null / empty record): the
+                        # claimed count is unbounded by remaining bytes —
+                        # charge it against the per-datum budget before
+                        # materializing (hostile-input cap; the native VM
+                        # applies the same rule)
+                        _charge_zero_width(count)
         return read_array
 
     if isinstance(t, Map):
-        value_reader = compile_reader(t.values)
+        value_reader = compile_reader(t.values, _depth + 1)
         def read_map(buf, pos):
             out = []
             while True:
@@ -153,25 +219,29 @@ def compile_reader(t: AvroType) -> Callable:
                         k = raw.decode("utf-8")
                     except UnicodeDecodeError as e:
                         raise MalformedAvro(
-                            f"invalid UTF-8 in map key: {e}"
+                            f"invalid UTF-8 in map key: {e}",
+                            err_name="bad_utf8",
                         ) from None
                     v, pos = value_reader(buf, pos)
                     out.append((k, v))
         return read_map
 
     if isinstance(t, Union):
-        readers = tuple(compile_reader(v) for v in t.variants)
+        readers = tuple(compile_reader(v, _depth + 1) for v in t.variants)
         n = len(readers)
         def read_union(buf, pos):
             idx, pos = read_long(buf, pos)
             if not 0 <= idx < n:
-                raise MalformedAvro(f"union branch {idx} out of range 0..{n}")
+                raise MalformedAvro(f"union branch {idx} out of range 0..{n}",
+                                    err_name="bad_branch")
             v, pos = readers[idx](buf, pos)
             return (idx, v), pos
         return read_union
 
     if isinstance(t, Record):
-        field_readers = tuple((f.name, compile_reader(f.type)) for f in t.fields)
+        field_readers = tuple(
+            (f.name, compile_reader(f.type, _depth + 1)) for f in t.fields
+        )
         def read_record(buf, pos):
             row = {}
             for name, rd in field_readers:
@@ -182,24 +252,85 @@ def compile_reader(t: AvroType) -> Callable:
     raise NotImplementedError(f"no reader for {t!r}")
 
 
+def _decode_one(datum, reader: Callable, limit: int):
+    """One datum through the reader with the hostile-input guards: the
+    PYRUHVRO_TPU_MAX_DATUM_BYTES ceiling fires before any decode work,
+    the per-datum zero-width item budget resets, trailing bytes error."""
+    if limit and len(datum) > limit:
+        raise MalformedAvro(
+            f"datum of {len(datum)} bytes exceeds "
+            f"PYRUHVRO_TPU_MAX_DATUM_BYTES={limit}",
+            err_name="datum_too_large",
+        )
+    _reset_zw_budget()
+    value, pos = reader(datum, 0)
+    if pos != len(datum):
+        raise MalformedAvro(
+            f"trailing bytes after datum: consumed {pos} of {len(datum)}",
+            err_name="trailing",
+        )
+    return value
+
+
 def decode_records(
-    data: Sequence[bytes], t: AvroType, reader: Callable = None
+    data: Sequence[bytes], t: AvroType, reader: Callable = None,
+    index_base: int = 0,
 ) -> List[object]:
     """Decode each datum fully; trailing bytes are an error.
 
     Pass a precompiled ``reader`` (from :func:`compile_reader`, cached per
-    schema via ``SchemaEntry.get_extra``) to skip per-call recompilation."""
+    schema via ``SchemaEntry.get_extra``) to skip per-call recompilation.
+    Errors carry the GLOBAL row index (``index_base`` + position), so the
+    chunked fallback path reports the same index as the native/device
+    tiers (``record <i>: <why>``)."""
     if reader is None:
         reader = compile_reader(t)
+    limit = max_datum_bytes()
     out = []
-    for datum in data:
-        value, pos = reader(datum, 0)
-        if pos != len(datum):
+    for j, datum in enumerate(data):
+        try:
+            out.append(_decode_one(datum, reader, limit))
+        except MalformedAvro as e:
+            i = index_base + j
             raise MalformedAvro(
-                f"trailing bytes after datum: consumed {pos} of {len(datum)}"
-            )
-        out.append(value)
+                f"record {i}: {e}", index=i,
+                err_name=e.err_name, tier="fallback",
+            ) from None
     return out
+
+
+def decode_records_tolerant(
+    data: Sequence[bytes], t: AvroType, reader: Callable = None,
+    index_base: int = 0,
+) -> Tuple[List[object], List[Tuple[int, bytes, str]]]:
+    """Per-record error capture (the error-policy layer's last resort and
+    the fallback tier's native mode): decode every datum independently,
+    returning ``(surviving_value_trees, errors)`` where errors is
+    ``[(global_index, raw_datum_bytes, err_name), ...]`` in row order.
+    Surviving values keep their relative order."""
+    return decode_pairs_tolerant(
+        [(index_base + j, d) for j, d in enumerate(data)], t, reader
+    )
+
+
+def decode_pairs_tolerant(
+    pairs: Sequence[Tuple[int, bytes]], t: AvroType, reader: Callable = None
+) -> Tuple[List[object], List[Tuple[int, bytes, str]]]:
+    """Like :func:`decode_records_tolerant` but over explicit
+    ``(global_index, datum)`` pairs — the shape the error-policy resume
+    loop holds after earlier offenders were already removed (survivor
+    sets are not contiguous index ranges)."""
+    if reader is None:
+        reader = compile_reader(t)
+    limit = max_datum_bytes()
+    out: List[object] = []
+    errors: List[Tuple[int, bytes, str]] = []
+    for gi, datum in pairs:
+        try:
+            out.append(_decode_one(datum, reader, limit))
+        except MalformedAvro as e:
+            errors.append((gi, bytes(datum), e.err_name or "malformed"))
+    return out, errors
 
 
 # ---------------------------------------------------------------------------
@@ -368,14 +499,26 @@ def decode_to_record_batch(
     t: AvroType,
     arrow_schema: pa.Schema = None,
     reader: Callable = None,
+    index_base: int = 0,
 ) -> pa.RecordBatch:
     """Full fallback decode: ``list[bytes]`` → ``pa.RecordBatch``
-    (≙ ``per_datum_deserialize_baseline``, ``deserialize.rs:34-48``)."""
+    (≙ ``per_datum_deserialize_baseline``, ``deserialize.rs:34-48``).
+    ``index_base`` offsets error indices so chunked callers report the
+    GLOBAL position of a malformed datum."""
     if not isinstance(t, Record):
         raise ValueError("top-level Avro schema must be a record")
     if arrow_schema is None:
         arrow_schema = to_arrow_schema(t)
-    rows = decode_records(data, t, reader)
+    rows = decode_records(data, t, reader, index_base)
+    return rows_to_record_batch(rows, t, arrow_schema)
+
+
+def rows_to_record_batch(
+    rows: List[object], t: AvroType, arrow_schema: pa.Schema
+) -> pa.RecordBatch:
+    """Stage 2 alone: decoded value trees → ``pa.RecordBatch`` (used by
+    the tolerant decode paths, which assemble from SURVIVING rows after
+    per-record error capture)."""
     if not t.fields:
         # zero-column batch must still carry the row count
         return pa.RecordBatch.from_struct_array(

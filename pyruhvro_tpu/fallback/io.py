@@ -17,10 +17,15 @@ Avro spec recap (wire format):
 
 from __future__ import annotations
 
+import os
 import struct
 
 __all__ = [
     "MalformedAvro",
+    "malformed_record",
+    "shift_malformed",
+    "max_datum_bytes",
+    "MAX_ZERO_WIDTH_ITEMS",
     "read_varint",
     "read_long",
     "read_float",
@@ -44,7 +49,85 @@ _pack_f64 = struct.Struct("<d").pack
 
 
 class MalformedAvro(ValueError):
-    """Raised on truncated or invalid Avro wire bytes."""
+    """Raised on truncated or invalid Avro wire bytes.
+
+    Structured fields back the error-policy layer (``on_error=`` in
+    :mod:`..api`): ``index`` is the GLOBAL row index of the offending
+    datum when the raiser knows it (None otherwise), ``err_name`` a
+    short machine-stable slug (feeds ``decode.quarantine.<err_name>``
+    counters), ``tier`` which decode tier detected it, and ``indices``
+    — set only by the device tier's error pass — every bad row of the
+    batch as ``[(index, err_name), ...]`` so tolerant callers isolate
+    all offenders in one extra launch instead of one per row."""
+
+    def __init__(self, message: str = "", index=None, err_name=None,
+                 tier=None, indices=None):
+        super().__init__(message)
+        self.index = index
+        self.err_name = err_name
+        self.tier = tier
+        self.indices = indices
+
+    def __reduce__(self):
+        # ValueError's default reduce rebuilds from args alone, which
+        # would drop the structured fields on the process-pool boundary
+        return (
+            _rebuild_malformed,
+            (self.args, self.index, self.err_name, self.tier, self.indices),
+        )
+
+
+def _rebuild_malformed(args, index, err_name, tier, indices):
+    e = MalformedAvro(*args)
+    e.index, e.err_name, e.tier, e.indices = index, err_name, tier, indices
+    return e
+
+
+def malformed_record(index: int, detail: str, err_name=None, tier=None,
+                     indices=None) -> MalformedAvro:
+    """The uniform cross-tier error shape: ``record <global_idx>: <why>``."""
+    return MalformedAvro(
+        f"record {index}: {detail}",
+        index=index, err_name=err_name, tier=tier, indices=indices,
+    )
+
+
+def shift_malformed(e: MalformedAvro, base: int) -> MalformedAvro:
+    """Re-base a chunk-local error to global row indices (``base`` added
+    to ``index``/``indices``); the message is rewritten to match."""
+    if not base or e.index is None:
+        return e
+    idx = e.index + base
+    msg = str(e)
+    prefix = f"record {e.index}: "
+    detail = msg[len(prefix):] if msg.startswith(prefix) else msg
+    return MalformedAvro(
+        f"record {idx}: {detail}", index=idx, err_name=e.err_name,
+        tier=e.tier,
+        indices=None if e.indices is None
+        else [(i + base, n) for i, n in e.indices],
+    )
+
+
+def max_datum_bytes() -> int:
+    """The PYRUHVRO_TPU_MAX_DATUM_BYTES hostile-input ceiling (0 =
+    unlimited, the default). A datum longer than this is rejected (or
+    quarantined under a tolerant policy) before any decode work."""
+    try:
+        return int(os.environ.get("PYRUHVRO_TPU_MAX_DATUM_BYTES", "0") or 0)
+    except ValueError:
+        return 0
+
+
+# Zero-width array/map items (null / empty-record elements consume no
+# wire bytes) are the one spot where a tiny datum can claim unbounded
+# output: a 3-byte block header can demand 2^60 items. Items of any
+# other type consume >= 1 byte each, so their counts are naturally
+# bounded by the remaining datum bytes. This cap bounds the total
+# zero-width items per DATUM; the native VM enforces the same constant
+# (kMaxZeroWidthItems, host_vm_core.h) so all tiers agree on
+# accept-vs-reject.
+MAX_ZERO_WIDTH_ITEMS = 1 << 20
 
 
 def zigzag_decode(n: int) -> int:
@@ -62,7 +145,7 @@ def read_varint(buf, pos: int):
     n = len(buf)
     while True:
         if pos >= n:
-            raise MalformedAvro("truncated varint")
+            raise MalformedAvro("truncated varint", err_name="overrun")
         b = buf[pos]
         pos += 1
         acc |= (b & 0x7F) << shift
@@ -70,7 +153,7 @@ def read_varint(buf, pos: int):
             return acc, pos
         shift += 7
         if shift > 63:
-            raise MalformedAvro("varint too long (max 10 bytes)")
+            raise MalformedAvro("varint too long (max 10 bytes)", err_name="varint")
 
 
 def read_long(buf, pos: int):
@@ -89,31 +172,31 @@ def read_long(buf, pos: int):
 
 def read_float(buf, pos: int):
     if pos + 4 > len(buf):
-        raise MalformedAvro("truncated float")
+        raise MalformedAvro("truncated float", err_name="overrun")
     return _unpack_f32(buf, pos)[0], pos + 4
 
 
 def read_double(buf, pos: int):
     if pos + 8 > len(buf):
-        raise MalformedAvro("truncated double")
+        raise MalformedAvro("truncated double", err_name="overrun")
     return _unpack_f64(buf, pos)[0], pos + 8
 
 
 def read_bool(buf, pos: int):
     if pos >= len(buf):
-        raise MalformedAvro("truncated bool")
+        raise MalformedAvro("truncated bool", err_name="overrun")
     b = buf[pos]
     if b > 1:
-        raise MalformedAvro(f"invalid bool byte {b:#x}")
+        raise MalformedAvro(f"invalid bool byte {b:#x}", err_name="bad_bool")
     return b == 1, pos + 1
 
 
 def read_bytes(buf, pos: int):
     ln, pos = read_long(buf, pos)
     if ln < 0:
-        raise MalformedAvro(f"negative bytes/string length {ln}")
+        raise MalformedAvro(f"negative bytes/string length {ln}", err_name="neg_len")
     if pos + ln > len(buf):
-        raise MalformedAvro("truncated bytes/string payload")
+        raise MalformedAvro("truncated bytes/string payload", err_name="overrun")
     return bytes(buf[pos : pos + ln]), pos + ln
 
 

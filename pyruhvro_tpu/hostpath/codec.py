@@ -19,8 +19,8 @@ from typing import List, Sequence
 import numpy as np
 import pyarrow as pa
 
-from ..fallback.io import MalformedAvro
-from ..ops.varint import ERR_NAMES
+from ..fallback.io import MalformedAvro, malformed_record
+from ..ops.varint import ERR_NAMES, ERR_SLUGS
 from ..runtime.native.build import load_host_codec
 from .program import HostProgram, lower_host
 
@@ -166,9 +166,11 @@ class NativeHostCodec:
                 _drain_native_prof(self._mod)
             if err_rec >= 0:
                 bit = err_bits & -err_bits
-                raise MalformedAvro(
-                    f"record {err_rec + index_base}: "
-                    f"{ERR_NAMES.get(bit, f'error bit {bit:#x}')}"
+                raise malformed_record(
+                    err_rec + index_base,
+                    ERR_NAMES.get(bit, f"error bit {bit:#x}"),
+                    err_name=ERR_SLUGS.get(bit, f"bit_{bit:#x}"),
+                    tier="native",
                 )
             host = {}
             for (key, dt, _region), b in zip(self._plan, bufs):

@@ -322,10 +322,21 @@ class _Gen(_GenBase):
             self.w(f"if (r.err) goto blk{u}_done;")
             self.w(f"if (r.cur > r.end) "
                    f"{{ r.err |= ERR_OVERRUN; goto blk{u}_done; }}")
+            # capture before the map key read (an entry with a key is
+            # never zero-width) — same rule as Vm::decode_blocks
+            self.w(f"int64_t c0_{u} = r.cur;")
             if kind == OP_MAP:
                 self.w(f"rd_string({self.c(b)}, r, true);")
                 self.w(f"if (r.err) goto blk{u}_done;")
             inner_end = self.gen(pc + 1, True)
+            # zero-width item guard — same rule as Vm::decode_blocks:
+            # a block of null/empty-record items charges its claimed
+            # count against the per-record kMaxZeroWidthItems budget
+            self.w(f"if (i{u} == 0 && r.cur == c0_{u}) {{")
+            self.w(f"  r.zw += cnt{u};")
+            self.w(f"  if (r.zw > kMaxZeroWidthItems) "
+                   f"{{ r.err |= ERR_OVERRUN; goto blk{u}_done; }}")
+            self.w("}")
             self.w(f"{offs}.running++;")
             self.w(f"if ({offs}.running < 0) "
                    f"{{ r.err |= ERR_OVERRUN; goto blk{u}_done; }}")

@@ -20,6 +20,7 @@ from typing import List, Sequence
 
 import pyarrow as pa
 
+from ..fallback.io import MalformedAvro, shift_malformed
 from ..schema.cache import SchemaEntry
 from . import UnsupportedOnDevice
 from .arrow_build import compact_union_slices
@@ -212,7 +213,12 @@ class DeviceCodec:
                 return self._host_decode(data)
             mid = len(data) // 2
             left = self.decode(data[:mid])
-            right = self.decode(data[mid:])
+            try:
+                right = self.decode(data[mid:])
+            except MalformedAvro as e:
+                # the right half reports half-local row indices; re-base
+                # so the public API always names the GLOBAL position
+                raise shift_malformed(e, mid) from None
             return _concat_batches([left, right])
         except DeviceCapacityExceeded:
             # a batch whose per-record item counts exceed device capacity

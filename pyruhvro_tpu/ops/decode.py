@@ -32,11 +32,11 @@ import numpy as np
 
 import time
 
-from ..fallback.io import MalformedAvro
+from ..fallback.io import MalformedAvro, malformed_record
 from ..runtime import metrics, telemetry
 from ..runtime.pack import bucket_len, concat_records
 from .fieldprog import ROWS, Program, lower
-from .varint import ERR_ITEM_OVERFLOW, ERR_NAMES
+from .varint import ERR_ITEM_OVERFLOW, ERR_NAMES, ERR_SLUGS
 
 __all__ = [
     "DeviceDecoder",
@@ -682,11 +682,23 @@ class DeviceDecoder:
                 )
             )[:n]
             bad = err & ~np.uint32(ERR_ITEM_OVERFLOW)
-            i = int(np.flatnonzero(bad)[0])
+            bad_rows = np.flatnonzero(bad)
+            # the walk computed error bits for EVERY lane — surface the
+            # full row mask so a tolerant caller (api.py on_error=skip/
+            # null) isolates all offenders in ONE extra pass instead of
+            # re-launching once per bad record
+            indices = []
+            for r in bad_rows:
+                v = int(bad[int(r)])
+                b = v & -v
+                indices.append((int(r), ERR_SLUGS.get(b, f"bit_{b:#x}")))
+            i = int(bad_rows[0])
             v = int(bad[i])
             bit = v & -v
-            raise MalformedAvro(
-                f"record {i}: {ERR_NAMES.get(bit, f'error bit {bit:#x}')}"
+            raise malformed_record(
+                i, ERR_NAMES.get(bit, f"error bit {bit:#x}"),
+                err_name=ERR_SLUGS.get(bit, f"bit_{bit:#x}"),
+                tier="device", indices=indices,
             )
 
         meta = {"item_totals": {}, "flat": flat}

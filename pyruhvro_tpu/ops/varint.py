@@ -45,6 +45,7 @@ __all__ = [
     "ERR_BAD_BOOL",
     "ERR_ITEM_OVERFLOW",
     "ERR_NAMES",
+    "ERR_SLUGS",
 ]
 
 U32 = jnp.uint32
@@ -70,6 +71,21 @@ ERR_NAMES = {
     ERR_BAD_BOOL: "invalid boolean byte",
     ERR_ITEM_OVERFLOW: "array/map item capacity overflow",
     ERR_DEC_RANGE: "decimal outside decimal128 range",
+}
+
+# short machine-stable slugs for the quarantine channel
+# (decode.quarantine.<slug> counters + QuarantinedRecord.error); the
+# fallback tier's raise sites use the same names
+ERR_SLUGS = {
+    ERR_VARINT: "varint",
+    ERR_NEG_LEN: "neg_len",
+    ERR_OVERRUN: "overrun",
+    ERR_BAD_BRANCH: "bad_branch",
+    ERR_BAD_ENUM: "bad_enum",
+    ERR_TRAILING: "trailing",
+    ERR_BAD_BOOL: "bad_bool",
+    ERR_ITEM_OVERFLOW: "item_overflow",
+    ERR_DEC_RANGE: "dec_range",
 }
 
 

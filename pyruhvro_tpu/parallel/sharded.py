@@ -22,7 +22,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 import numpy as np
 import pyarrow as pa
 
-from ..fallback.io import MalformedAvro
+from ..fallback.io import MalformedAvro, malformed_record
 from ..ops.decode import (
     BatchTooLarge,
     DeviceDecoder,
@@ -32,7 +32,7 @@ from ..ops.decode import (
     unpack_launch_input,
 )
 from ..ops.fieldprog import ROWS
-from ..ops.varint import ERR_ITEM_OVERFLOW, ERR_NAMES
+from ..ops.varint import ERR_ITEM_OVERFLOW, ERR_NAMES, ERR_SLUGS
 from ..runtime.chunking import chunk_bounds
 from ..runtime.pack import bucket_len, concat_records
 
@@ -250,12 +250,20 @@ class ShardedDecoder:
         idx = np.flatnonzero(bad)
         if idx.size == 0:  # pragma: no cover — err flag implies a bad lane
             raise MalformedAvro("device reported a malformed record")
+        indices = []
+        for r in idx:
+            v = int(bad[int(r)])
+            b = v & -v
+            indices.append(
+                (base_row + int(r), ERR_SLUGS.get(b, f"bit_{b:#x}"))
+            )
         i = int(idx[0])
         v = int(bad[i])
         bit = v & -v
-        raise MalformedAvro(
-            f"record {base_row + i}: "
-            f"{ERR_NAMES.get(bit, f'error bit {bit:#x}')}"
+        raise malformed_record(
+            base_row + i, ERR_NAMES.get(bit, f"error bit {bit:#x}"),
+            err_name=ERR_SLUGS.get(bit, f"bit_{bit:#x}"),
+            tier="device", indices=indices,
         )
 
     def decode(self, data: Sequence[bytes], ir=None,

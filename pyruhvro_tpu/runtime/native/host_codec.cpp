@@ -212,11 +212,27 @@ class Vm {
           r.err |= ERR_OVERRUN;
           return;
         }
+        // capture BEFORE the map key read: an entry is only zero-width
+        // when the whole entry (key included) consumes nothing, so map
+        // entries (key >= 1 byte) never charge — mirroring the fallback
+        // walker, whose read_map has no zero-width lane at all
+        int64_t before = r.cur;
         if (is_map) {
           rd_string(*key_col, r, true);
           if (r.err) return;
         }
         exec(pc + 1, r, true);
+        if (i == 0 && r.cur == before) {
+          // zero-width items (null / empty record): the claimed count
+          // is unbounded by remaining bytes — charge the per-record
+          // budget before looping (hostile-input cap; the fallback
+          // walker applies the same rule)
+          r.zw += count;
+          if (r.zw > kMaxZeroWidthItems) {
+            r.err |= ERR_OVERRUN;
+            return;
+          }
+        }
         offs.running++;
         if (offs.running < 0) {  // int32 overflow: batch too large
           r.err |= ERR_OVERRUN;

@@ -288,11 +288,21 @@ struct Col {
   int32_t running = 0;  // COL_OFFS running item total
 };
 
+// Hostile-input cap on zero-width array/map items per record: null /
+// empty-record elements consume no wire bytes, so a claimed block count
+// is the one quantity the remaining-bytes bound cannot limit (a 3-byte
+// block header may demand 2^60 items). Items of any other shape consume
+// >= 1 byte each, which bounds their counts by the record length. Keep
+// in sync with fallback/io.py MAX_ZERO_WIDTH_ITEMS so all tiers agree
+// on accept-vs-reject.
+constexpr int64_t kMaxZeroWidthItems = 1 << 20;
+
 struct Reader {
   const uint8_t* base;  // flat buffer start
   int64_t cur;          // global cursor
   int64_t end;          // record end (global)
   int32_t err = 0;
+  int64_t zw = 0;       // zero-width items consumed by this record
 
   inline uint64_t read_raw_varint() {
     // 1-byte fast path: the overwhelmingly common case on real data

@@ -12,8 +12,11 @@ the TPU backend.
 process pool for the host tiers (``api.py`` routes eligible calls to
 :func:`map_chunks_proc`). Workers run under
 :class:`..telemetry.worker_scope` and ship their counter deltas + span
-tree back WITH each chunk result, so the parent's ``snapshot()`` still
-covers 100% of the work — nothing is dropped on the process boundary.
+tree — and, under a tolerant ``on_error`` policy, their chunk's
+quarantine entries (already re-based to global row indices) — back WITH
+each chunk result, so the parent's ``snapshot()`` and quarantine
+channel still cover 100% of the work — nothing is dropped on the
+process boundary.
 
 Either way, every chunk is accounted: the per-chunk span carries the
 chunk's row count and its counter deltas, and ``pool.worker_rows`` sums
@@ -141,9 +144,14 @@ def map_chunks_proc(task: Callable, payloads: Sequence,
         metrics.inc("pool.proc_fanouts")
     try:
         futures = [get_process_pool().submit(task, p) for p in payloads]
+        # collect EVERY result before merging any worker telemetry: a
+        # fan-out that dies midway (broken pool, a worker's poison-datum
+        # error) must leave the parent's counters and quarantine
+        # collector untouched — the caller retries on the thread path,
+        # and partial merges would double-count the retried work
+        results = [fut.result() for fut in futures]
         out = []
-        for i, fut in enumerate(futures):
-            result, payload = fut.result()
+        for i, (result, payload) in enumerate(results):
             telemetry.merge_worker(payload)
             out.append(result)
             n = rows(payloads[i]) if rows is not None else None

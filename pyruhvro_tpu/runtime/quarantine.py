@@ -1,0 +1,152 @@
+"""Quarantine / dead-letter channel for the error-policy layer.
+
+When a public API call runs with ``on_error="skip"`` or ``"null"``
+(:mod:`..api`), corrupt datums no longer abort the batch: each offender
+is captured here as a :class:`QuarantinedRecord` — its GLOBAL row index,
+the raw wire bytes (decode side; ``None`` for encode-side quarantines,
+which have no wire form), a short machine-stable error slug, and the
+tier that detected it. The channel is observable three ways:
+
+* :func:`last` (re-exported as ``pyruhvro_tpu.last_quarantine``) — the
+  most recent call's quarantine list on this thread;
+* ``return_errors=True`` on the API call — the structured
+  ``(result, quarantine)`` return;
+* telemetry — ``decode.quarantined`` / ``decode.quarantine.<err_name>``
+  counters, ``quarantined=`` on the call's root span (and therefore in
+  the PR 3 flight recorder), plus an automatic flight dump when a
+  quarantine storm hits and ``PYRUHVRO_TPU_FLIGHT_DIR`` is set.
+
+Entries are plain picklable tuples so process-pool workers ship their
+chunk's quarantines back with the telemetry payload
+(``telemetry.worker_scope`` / ``merge_worker``) — nothing is dropped on
+the pool boundary.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import List, NamedTuple, Optional
+
+from . import metrics
+
+__all__ = [
+    "QuarantinedRecord",
+    "collecting",
+    "extend_current",
+    "last",
+    "set_last",
+    "publish",
+]
+
+
+class QuarantinedRecord(NamedTuple):
+    """One dead-lettered row of a tolerant API call."""
+
+    index: int            # GLOBAL row index in the call's input
+    datum: Optional[bytes]  # raw wire bytes (None for encode-side rows)
+    error: str            # short slug, e.g. "overrun", "bad_branch"
+    tier: str             # "fallback" | "native" | "device" | "policy"
+
+
+_tls = threading.local()
+
+
+class collecting:
+    """Open a quarantine collector for the current API call.
+
+    The collector list is the context value; chunk closures append to it
+    directly (list.append is atomic under the GIL, and entries are
+    sorted by index at publish time), while process-pool merges reach it
+    through :func:`extend_current` on the caller thread."""
+
+    __slots__ = ("entries", "_prev", "_prev_merged")
+
+    def __enter__(self) -> List[QuarantinedRecord]:
+        self._prev = getattr(_tls, "active", None)
+        self._prev_merged = getattr(_tls, "merged", 0)
+        self.entries: List[QuarantinedRecord] = []
+        _tls.active = self.entries
+        _tls.merged = 0
+        return self.entries
+
+    def __exit__(self, *exc):
+        _tls.active = self._prev
+        _tls.merged = self._prev_merged
+        return False
+
+
+def extend_current(entries) -> None:
+    """Fold worker-shipped quarantine tuples into the active collector
+    (no-op outside a tolerant call — e.g. counters-only merges). The
+    merged count is remembered: the workers already fed the quarantine
+    COUNTERS in their own processes (and those deltas merge separately
+    via telemetry.merge_worker), so :func:`publish` must not re-count
+    them."""
+    active = getattr(_tls, "active", None)
+    if active is None or not entries:
+        return
+    for e in entries:
+        active.append(QuarantinedRecord(*e))
+    _tls.merged = getattr(_tls, "merged", 0) + len(entries)
+
+
+def reset_merged() -> None:
+    """Drop the merged-entry memo (the caller cleared the collector to
+    retry a failed pool fan-out on the thread path)."""
+    _tls.merged = 0
+
+
+def set_last(entries: List[QuarantinedRecord]) -> None:
+    _tls.last = list(entries)
+
+
+def last() -> List[QuarantinedRecord]:
+    """The quarantine list of the most recent TOLERANT
+    (``on_error="skip"``/``"null"``) API call on this thread — empty
+    when that call was clean. errno-style: ``"raise"``-policy calls
+    leave it untouched, so read it right after the tolerant call it
+    describes (or use ``return_errors=True`` for an unambiguous per-call
+    binding)."""
+    return list(getattr(_tls, "last", ()))
+
+
+def _storm_threshold() -> int:
+    try:
+        return int(
+            os.environ.get("PYRUHVRO_TPU_QUARANTINE_STORM", "") or 100
+        )
+    except ValueError:
+        return 100
+
+
+def publish(entries: List[QuarantinedRecord], policy: str,
+            op: str = "decode") -> None:
+    """Close out one tolerant call: order entries, expose them via
+    :func:`last`, feed the ``<op>.quarantined`` counters/span, and leave
+    a flight-recorder dump behind on a quarantine storm
+    (>= PYRUHVRO_TPU_QUARANTINE_STORM rows, default 100, when
+    PYRUHVRO_TPU_FLIGHT_DIR is configured)."""
+    from . import telemetry
+
+    entries.sort(key=lambda e: e.index)
+    set_last(entries)
+    telemetry.annotate(on_error=policy, quarantined=len(entries))
+    if not entries:
+        return
+    # entries merged from pool workers were already counted in the
+    # worker process (and those deltas merged via merge_worker) — only
+    # locally-detected entries feed the counters here. The two sources
+    # are exclusive per call (pool fan-out OR local chunks).
+    merged = min(getattr(_tls, "merged", 0), len(entries))
+    if merged == 0:
+        metrics.inc(op + ".quarantined", float(len(entries)))
+        for e in entries:
+            metrics.inc(f"{op}.quarantine.{e.error}")
+    elif merged < len(entries):
+        # mixed source (shouldn't happen per call; defensive): count
+        # the locally-detected remainder without slug attribution
+        metrics.inc(op + ".quarantined", float(len(entries) - merged))
+    if len(entries) >= _storm_threshold():
+        metrics.inc(op + ".quarantine_storms")
+        telemetry._flight_autodump("quarantine")

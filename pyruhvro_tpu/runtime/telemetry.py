@@ -558,6 +558,14 @@ def merge_worker(payload: Dict[str, Any], *, counters: bool = True) -> None:
         if rows:
             metrics.inc("pool.worker_rows", float(rows))
     metrics.inc("pool.worker_merges")
+    q = payload.get("quarantine")
+    if q:
+        # quarantine entries survive the pool merge: fold the worker's
+        # dead-lettered rows (already re-based to global indices) into
+        # the caller's active collector
+        from . import quarantine as _quarantine
+
+        _quarantine.extend_current(q)
     sd = payload.get("span")
     if sd and _enabled:
         parent = getattr(_tls, "span", None)

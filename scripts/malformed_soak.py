@@ -1,0 +1,65 @@
+#!/usr/bin/env python
+"""Malformed-bytes soak (bounded): the mutation fuzz of
+tests/test_fuzz_malformed.py scaled up and run as a standalone gate for
+the wheel-build CI job.
+
+Random schemas x mutated corpora (truncate / bit-flip / splice) through
+the native VM vs the pure-Python oracle:
+
+  * crash-freedom — every record either decodes or raises a
+    ValueError-family error (MalformedAvro/ArrowInvalid), never
+    anything else, never memory-unsafely;
+  * accept-vs-reject agreement per record, equal decodes on accepts;
+  * on_error="skip" parity — fallback and native tiers return
+    byte-identical surviving rows and identical quarantine indices.
+
+Usage::
+
+    JAX_PLATFORMS=cpu python scripts/malformed_soak.py [first_seed] [n]
+
+Iterations are bounded (default 40 schemas x 40 records x ~3 mutations
+each); exit 1 on any divergence.
+"""
+
+from __future__ import annotations
+
+import sys
+import traceback
+
+# APPEND (not insert): when a wheel is installed the soak must exercise
+# THAT build's compiled extensions (the CI wheel job's whole point) —
+# the checkout only backs imports that aren't installed (the tests
+# package, or a source-tree run with no wheel present).
+sys.path.append(".")
+sys.path.append("tests")
+
+
+def main() -> int:
+    from test_fuzz_malformed import _check_schema_seed
+
+    from pyruhvro_tpu.hostpath import native_available
+    from pyruhvro_tpu.utils.datagen import random_schema
+
+    if not native_available():
+        print("native toolchain unavailable; soak skipped")
+        return 0
+    first = int(sys.argv[1]) if len(sys.argv) > 1 else 200
+    count = int(sys.argv[2]) if len(sys.argv) > 2 else 40
+    fails = 0
+    for seed in range(first, first + count):
+        try:
+            _check_schema_seed(random_schema(seed), seed)
+            if seed % 10 == 0:
+                print(f"seed {seed} ok", flush=True)
+        except Exception as ex:  # noqa: BLE001 — report and count
+            fails += 1
+            print(f"SEED {seed} FAILED: {ex!r}", flush=True)
+            traceback.print_exc()
+            if fails > 3:
+                return 1
+    print(f"malformed soak complete: {count} schemas, {fails} failures")
+    return 1 if fails else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,462 @@
+"""Error-policy layer (ISSUE 4): on_error="raise"/"skip"/"null",
+quarantine channel, hostile-input resource limits, and the global-index
+unification across tiers and chunk counts.
+"""
+
+import os
+
+import pyarrow as pa
+import pytest
+
+import pyruhvro_tpu as p
+from pyruhvro_tpu.fallback.io import MalformedAvro, shift_malformed
+from pyruhvro_tpu.hostpath import native_available
+from pyruhvro_tpu.runtime import metrics, quarantine, telemetry
+from pyruhvro_tpu.schema.cache import get_or_parse_schema
+from pyruhvro_tpu.utils.datagen import (
+    KAFKA_SCHEMA_JSON,
+    kafka_style_datums,
+    random_datums,
+)
+
+NULLABLE_SCHEMA = """\
+{"type":"record","name":"N","fields":[
+  {"name":"a","type":["null","long"]},
+  {"name":"s","type":["null","string"]}]}"""
+
+FLAT_SCHEMA = """\
+{"type":"record","name":"F","fields":[
+  {"name":"x","type":"long"},{"name":"s","type":"string"}]}"""
+
+
+def zz(v: int) -> bytes:
+    z = v << 1 if v >= 0 else ((-v) << 1) - 1
+    out = bytearray()
+    while z >= 0x80:
+        out.append((z & 0x7F) | 0x80)
+        z >>= 7
+    out.append(z)
+    return bytes(out)
+
+
+def corrupt_corpus(schema: str, n: int = 60, bad=(5, 17, 41), seed=7):
+    entry = get_or_parse_schema(schema)
+    datums = random_datums(entry.ir, n, seed=seed)
+    for i in bad:
+        datums[i] = datums[i][: max(1, len(datums[i]) // 2)] or b"\xff"
+    # make sure each corruption actually rejects (truncation can yield a
+    # valid prefix on some shapes) — force a hard error if needed
+    from pyruhvro_tpu.fallback.decoder import decode_records
+
+    for i in bad:
+        try:
+            decode_records([datums[i]], entry.ir)
+            datums[i] = b"\xff" * 3 + datums[i]
+            decode_records([datums[i]], entry.ir)
+            datums[i] = b""  # last resort: empty datum never decodes a
+            # record with >= 1 non-null field
+        except MalformedAvro:
+            pass
+    return datums
+
+
+TIERS = ["fallback", "native", "device"]
+
+
+def run_tier(tier, fn):
+    """Run ``fn(backend)`` with the environment pinning one tier."""
+    if tier == "native" and not native_available():
+        pytest.skip("native toolchain unavailable")
+    if tier == "fallback":
+        os.environ["PYRUHVRO_TPU_NO_NATIVE"] = "1"
+        try:
+            return fn("host")
+        finally:
+            del os.environ["PYRUHVRO_TPU_NO_NATIVE"]
+    if tier == "native":
+        return fn("host")
+    return fn("tpu")
+
+
+@pytest.mark.parametrize("tier", TIERS)
+def test_skip_drops_and_quarantines(tier):
+    datums = corrupt_corpus(FLAT_SCHEMA)
+
+    def go(backend):
+        batch, errs = p.deserialize_array(
+            datums, FLAT_SCHEMA, backend=backend, on_error="skip",
+            return_errors=True,
+        )
+        assert batch.num_rows == len(datums) - 3
+        assert [q.index for q in errs] == [5, 17, 41]
+        assert [q.index for q in p.last_quarantine()] == [5, 17, 41]
+        for q in errs:
+            assert q.datum == datums[q.index]
+            assert q.error and q.tier
+        # survivors equal the oracle's view of the surviving subset
+        from pyruhvro_tpu.fallback.decoder import decode_to_record_batch
+
+        entry = get_or_parse_schema(FLAT_SCHEMA)
+        keep = [d for j, d in enumerate(datums) if j not in (5, 17, 41)]
+        want = decode_to_record_batch(keep, entry.ir, entry.arrow_schema)
+        assert batch.equals(want)
+
+    run_tier(tier, go)
+
+
+@pytest.mark.parametrize("tier", TIERS)
+def test_raise_default_unchanged(tier):
+    datums = corrupt_corpus(FLAT_SCHEMA)
+
+    def go(backend):
+        with pytest.raises(MalformedAvro) as ei:
+            p.deserialize_array(datums, FLAT_SCHEMA, backend=backend)
+        assert ei.value.index == 5
+        assert "record 5" in str(ei.value)
+
+    run_tier(tier, go)
+
+
+@pytest.mark.parametrize("tier", TIERS)
+@pytest.mark.parametrize("chunks", [1, 3, 8])
+def test_global_index_uniform_across_tiers_and_chunks(tier, chunks):
+    """Satellite: the reported index of a poisoned datum is the GLOBAL
+    row index on every tier and for every chunk count."""
+    datums = corrupt_corpus(FLAT_SCHEMA, n=64, bad=(41,))
+
+    def go(backend):
+        with pytest.raises(MalformedAvro) as ei:
+            p.deserialize_array_threaded(
+                datums, FLAT_SCHEMA, chunks, backend=backend)
+        assert ei.value.index == 41, str(ei.value)
+        assert "record 41" in str(ei.value)
+
+    run_tier(tier, go)
+
+
+@pytest.mark.parametrize("tier", TIERS)
+def test_skip_chunked_parity(tier):
+    datums = corrupt_corpus(FLAT_SCHEMA, n=64, bad=(2, 33, 62))
+
+    def go(backend):
+        outs, errs = p.deserialize_array_threaded(
+            datums, FLAT_SCHEMA, 4, backend=backend, on_error="skip",
+            return_errors=True,
+        )
+        assert sum(o.num_rows for o in outs) == 61
+        assert [q.index for q in errs] == [2, 33, 62]
+
+    run_tier(tier, go)
+
+
+def test_null_policy_preserves_rows_on_nullable_schema():
+    entry = get_or_parse_schema(NULLABLE_SCHEMA)
+    datums = random_datums(entry.ir, 20, seed=3)
+    datums[7] = b"\x05"  # bad union branch
+    batch = p.deserialize_array(
+        datums, NULLABLE_SCHEMA, backend="host", on_error="null")
+    assert batch.num_rows == 20
+    assert batch.to_pylist()[7] == {"a": None, "s": None}
+    assert [q.index for q in p.last_quarantine()] == [7]
+
+
+def test_null_policy_degrades_to_skip_on_non_nullable_schema():
+    datums = corrupt_corpus(FLAT_SCHEMA, bad=(5,))
+    batch = p.deserialize_array(
+        datums, FLAT_SCHEMA, backend="host", on_error="null")
+    assert batch.num_rows == len(datums) - 1
+    assert metrics.snapshot().get("decode.null_unsupported_schema")
+
+
+def test_on_error_validation():
+    with pytest.raises(ValueError):
+        p.deserialize_array([], FLAT_SCHEMA, on_error="ignore")
+    with pytest.raises(ValueError):
+        p.serialize_record_batch(
+            pa.RecordBatch.from_pylist([], schema=pa.schema([])),
+            FLAT_SCHEMA, 1, on_error="drop")
+
+
+def test_quarantine_counters_and_span():
+    datums = corrupt_corpus(FLAT_SCHEMA, bad=(5, 17))
+    p.deserialize_array(datums, FLAT_SCHEMA, backend="host",
+                        on_error="skip")
+    snap = telemetry.snapshot()
+    assert snap["counters"]["decode.quarantined"] == 2.0
+    by_err = [k for k in snap["counters"]
+              if k.startswith("decode.quarantine.")]
+    assert by_err
+    root = snap["spans"][-1]
+    assert root["attrs"]["quarantined"] == 2
+    assert root["attrs"]["on_error"] == "skip"
+
+
+def test_flight_dump_on_quarantine_storm(tmp_path, monkeypatch):
+    monkeypatch.setenv("PYRUHVRO_TPU_FLIGHT_DIR", str(tmp_path))
+    monkeypatch.setenv("PYRUHVRO_TPU_QUARANTINE_STORM", "2")
+    datums = corrupt_corpus(FLAT_SCHEMA, bad=(5, 17, 41))
+    p.deserialize_array(datums, FLAT_SCHEMA, backend="host",
+                        on_error="skip")
+    dumps = [f for f in os.listdir(tmp_path) if "quarantine" in f]
+    assert dumps, "storm must leave a flight-recorder dump"
+    assert metrics.snapshot().get("decode.quarantine_storms") == 1.0
+
+
+def test_encode_skip_and_null():
+    from decimal import Decimal
+
+    DS = ('{"type":"record","name":"D","fields":[{"name":"d","type":'
+          '{"type":"fixed","name":"Fx","size":1,"logicalType":"decimal",'
+          '"precision":3,"scale":0}}]}')
+    arr = pa.array([Decimal(1), Decimal(500), Decimal(7)],
+                   type=pa.decimal128(3, 0))
+    batch = pa.RecordBatch.from_arrays([arr], names=["d"])
+    with pytest.raises(OverflowError):
+        p.serialize_record_batch(batch, DS, 1, backend="host")
+    [out], errs = p.serialize_record_batch(
+        batch, DS, 1, backend="host", on_error="skip",
+        return_errors=True)
+    assert len(out) == 2 and [q.index for q in errs] == [1]
+    assert errs[0].datum is None
+    rt = p.deserialize_array([bytes(x) for x in out], DS, backend="host")
+    assert [r["d"] for r in rt.to_pylist()] == [Decimal(1), Decimal(7)]
+
+
+def test_worker_malformed_counter(monkeypatch):
+    """Satellite: a process-pool worker dying on a poison datum
+    re-raises the worker's error (original name + GLOBAL index) and
+    counts pool.worker_malformed, not pool.process_fallback."""
+    from pyruhvro_tpu import api
+
+    err = shift_malformed(
+        MalformedAvro("record 3: truncated varint", index=3,
+                      err_name="overrun", tier="fallback"),
+        40,
+    )
+
+    def boom(task, payloads, rows=None):
+        raise err
+
+    monkeypatch.setattr(api, "map_chunks_proc", boom)
+    with pytest.raises(MalformedAvro) as ei:
+        api._proc_map(api._proc_decode_task, [], rows=None)
+    assert ei.value.index == 43
+    assert "record 43" in str(ei.value)
+    snap = metrics.snapshot()
+    assert snap.get("pool.worker_malformed") == 1.0
+    assert "pool.process_fallback" not in snap
+
+
+def test_malformed_pickle_roundtrip():
+    import pickle
+
+    e = MalformedAvro("record 9: bad", index=9, err_name="overrun",
+                      tier="native", indices=[(9, "overrun")])
+    e2 = pickle.loads(pickle.dumps(e))
+    assert (e2.index, e2.err_name, e2.tier, e2.indices) == (
+        9, "overrun", "native", [(9, "overrun")])
+    assert str(e2) == str(e)
+
+
+# -- hostile-input resource limits ------------------------------------------
+
+
+def test_giant_string_claim_rejected_without_alloc():
+    SS = ('{"type":"record","name":"S","fields":'
+          '[{"name":"s","type":"string"}]}')
+    claim = zz(2 << 30) + b"ab"  # 10-byte datum claiming a 2 GiB string
+    for env in (None, "1"):
+        if env:
+            os.environ["PYRUHVRO_TPU_NO_NATIVE"] = env
+        try:
+            with pytest.raises(MalformedAvro):
+                p.deserialize_array([claim], SS, backend="host")
+        finally:
+            os.environ.pop("PYRUHVRO_TPU_NO_NATIVE", None)
+
+
+@pytest.mark.skipif(not native_available(),
+                    reason="native toolchain unavailable")
+def test_zero_width_item_bomb_rejected_all_host_tiers():
+    ZS = ('{"type":"record","name":"Z","fields":[{"name":"a","type":'
+          '{"type":"array","items":"null"}}]}')
+    bomb = zz(1 << 40) + b"\x00"
+    legal = zz(3) + b"\x00"
+    entry = get_or_parse_schema(ZS)
+    from pyruhvro_tpu.fallback.decoder import (
+        decode_records,
+        decode_to_record_batch,
+    )
+    from pyruhvro_tpu.hostpath import NativeHostCodec
+
+    with pytest.raises(MalformedAvro):
+        decode_records([bomb], entry.ir)
+    codec = NativeHostCodec(entry.ir, entry.arrow_schema)
+    with pytest.raises(MalformedAvro):
+        codec.decode([bomb])
+    # legal zero-width items still decode identically on both tiers
+    want = decode_to_record_batch([legal], entry.ir, entry.arrow_schema)
+    assert codec.decode([legal]).equals(want)
+
+
+def test_max_datum_bytes_knob(monkeypatch):
+    SS = ('{"type":"record","name":"S","fields":'
+          '[{"name":"s","type":"string"}]}')
+    big = zz(10) + b"x" * 10
+    monkeypatch.setenv("PYRUHVRO_TPU_MAX_DATUM_BYTES", "4")
+    with pytest.raises(MalformedAvro) as ei:
+        p.deserialize_array([big], SS, backend="host")
+    assert ei.value.err_name == "datum_too_large"
+    batch, errs = p.deserialize_array(
+        [big], SS, backend="host", on_error="skip", return_errors=True)
+    assert batch.num_rows == 0
+    assert errs[0].error == "datum_too_large"
+    monkeypatch.delenv("PYRUHVRO_TPU_MAX_DATUM_BYTES")
+    assert p.deserialize_array([big], SS, backend="host").num_rows == 1
+
+
+def test_walker_depth_cap():
+    from pyruhvro_tpu.fallback.decoder import compile_reader
+
+    deep = '"long"'
+    for i in range(80):
+        deep = ('{"type":"record","name":"R%d","fields":'
+                '[{"name":"f","type":%s}]}' % (i, deep))
+    with pytest.raises(ValueError, match="nesting depth"):
+        compile_reader(get_or_parse_schema(deep).ir)
+
+
+# -- acceptance: 1%-corrupt batch decodes on every tier ---------------------
+
+
+@pytest.mark.parametrize("tier", TIERS)
+def test_one_percent_corrupt_batch(tier):
+    """The ISSUE acceptance shape (scaled for the quick suite; the slow
+    marker below runs the full 100k): a batch with 1% corrupt datums
+    decodes under on_error="skip" with every corrupt row quarantined at
+    its correct global index."""
+    n, step = 2_000, 100
+    datums = kafka_style_datums(n, seed=11)
+    bad = list(range(7, n, step))
+    for i in bad:
+        datums[i] = datums[i][: len(datums[i]) // 3] or b"\xff"
+    schema = KAFKA_SCHEMA_JSON if tier != "device" else FLAT_SCHEMA
+    if tier == "device":
+        entry = get_or_parse_schema(FLAT_SCHEMA)
+        datums = random_datums(entry.ir, n, seed=11)
+        for i in bad:
+            datums[i] = b"\x01"
+    from pyruhvro_tpu.fallback.decoder import decode_records
+
+    entry = get_or_parse_schema(schema)
+    truly_bad = []
+    for i in bad:
+        try:
+            decode_records([datums[i]], entry.ir)
+        except MalformedAvro:
+            truly_bad.append(i)
+    assert truly_bad, "corruption must reject at least some rows"
+
+    def go(backend):
+        batch, errs = p.deserialize_array(
+            datums, schema, backend=backend, on_error="skip",
+            return_errors=True)
+        assert batch.num_rows == n - len(truly_bad)
+        assert [q.index for q in errs] == truly_bad
+
+    run_tier(tier, go)
+
+
+@pytest.mark.slow
+def test_acceptance_100k_one_percent_skip():
+    n = 100_000
+    datums = kafka_style_datums(n, seed=13)
+    bad = list(range(50, n, 100))
+    for i in bad:
+        datums[i] = datums[i][: len(datums[i]) // 3] or b"\xff"
+    from pyruhvro_tpu.fallback.decoder import decode_records
+
+    entry = get_or_parse_schema(KAFKA_SCHEMA_JSON)
+    truly_bad = [
+        i for i in bad
+        if not _decodes(datums[i], entry.ir)
+    ]
+    batch, errs = p.deserialize_array(
+        datums, KAFKA_SCHEMA_JSON, backend="host", on_error="skip",
+        return_errors=True)
+    assert batch.num_rows == n - len(truly_bad)
+    assert [q.index for q in errs] == truly_bad
+
+
+def _decodes(datum, ir) -> bool:
+    from pyruhvro_tpu.fallback.decoder import decode_records
+
+    try:
+        decode_records([datum], ir)
+        return True
+    except MalformedAvro:
+        return False
+
+
+_PROC_QUAR_SCRIPT = """
+import os
+from pyruhvro_tpu import deserialize_array_threaded, last_quarantine, telemetry
+from pyruhvro_tpu.fallback.io import MalformedAvro
+from pyruhvro_tpu.utils.datagen import kafka_style_datums
+
+K = %r
+
+def main():
+    data = kafka_style_datums(400, seed=21)
+    bad = [33, 180, 351]
+    for i in bad:
+        data[i] = data[i][: len(data[i]) // 3] or b"\\xff"
+    # tolerant: quarantine entries must cross the spawn-pool boundary
+    # with GLOBAL indices
+    out, errs = deserialize_array_threaded(
+        data, K, 4, backend="host", on_error="skip", return_errors=True)
+    assert sum(b.num_rows for b in out) == 397, [b.num_rows for b in out]
+    assert [q.index for q in errs] == bad, errs
+    assert [q.index for q in last_quarantine()] == bad
+    assert all(q.datum == data[q.index] for q in errs)
+    snap = telemetry.snapshot()["counters"]
+    assert snap.get("pool.proc_chunks") == 4, snap
+    assert snap.get("decode.quarantined") == 3.0, snap
+    # raise: the worker's MalformedAvro re-raises with the worker's
+    # error name + GLOBAL index and counts pool.worker_malformed
+    telemetry.reset()
+    try:
+        deserialize_array_threaded(data, K, 4, backend="host")
+        raise SystemExit("expected MalformedAvro")
+    except MalformedAvro as e:
+        assert e.index == 33, (e.index, str(e))
+        assert "record 33" in str(e), str(e)
+    snap = telemetry.snapshot()["counters"]
+    assert snap.get("pool.worker_malformed") == 1.0, snap
+    assert snap.get("pool.process_fallback") is None, snap
+    print("PROC-QUAR-OK")
+
+if __name__ == "__main__":
+    main()
+"""
+
+
+@pytest.mark.slow
+def test_process_pool_quarantine_survives_merge(tmp_path):
+    """Satellite: quarantine payloads survive the spawn-pool merge, and
+    a worker's poison-datum death re-raises with the global index."""
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    script = tmp_path / "proc_quar_check.py"
+    script.write_text(_PROC_QUAR_SCRIPT % KAFKA_SCHEMA_JSON)
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               PYRUHVRO_TPU_POOL="process",
+               PYTHONPATH=repo + os.pathsep
+               + os.environ.get("PYTHONPATH", ""))
+    r = subprocess.run([sys.executable, str(script)],
+                       capture_output=True, text=True, env=env,
+                       cwd=repo, timeout=300)
+    assert r.returncode == 0, (r.stdout, r.stderr)
+    assert "PROC-QUAR-OK" in r.stdout

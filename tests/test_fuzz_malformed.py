@@ -1,0 +1,172 @@
+"""Malformed-bytes mutation fuzz (ISSUE 4 satellite): truncate /
+bit-flip / splice valid corpora from the differential fuzzer's
+generators, then assert
+
+(a) no crash/segfault: the native VM (and, in the slow sweep, the
+    schema-SPECIALIZED engines) either returns a batch or raises
+    MalformedAvro — never anything else, never memory-unsafe;
+(b) accept-vs-reject agreement per record between the pure-Python
+    oracle and the native VM (and when both accept, equal decodes);
+(c) under ``on_error="skip"`` every tier returns byte-identical
+    surviving rows with identical quarantine indices.
+
+The quick (-m 'not slow') subset runs a handful of seeds; CI's full
+sweep (`-m slow` + scripts/malformed_soak.py in the wheel job) covers
+the rest including the specialized engines.
+"""
+
+import random
+
+import pytest
+
+import pyruhvro_tpu as p
+from pyruhvro_tpu.fallback.decoder import (
+    decode_records,
+    decode_to_record_batch,
+)
+from pyruhvro_tpu.fallback.io import MalformedAvro
+from pyruhvro_tpu.hostpath import NativeHostCodec, native_available
+from pyruhvro_tpu.schema.cache import get_or_parse_schema
+from pyruhvro_tpu.utils.datagen import random_datums, random_schema
+
+pytestmark = pytest.mark.skipif(
+    not native_available(), reason="native toolchain unavailable"
+)
+
+FLAT_SCHEMA = """\
+{"type":"record","name":"F","fields":[
+  {"name":"x","type":"long"},{"name":"s","type":"string"}]}"""
+
+
+def mutate_corpus(datums, seed, rate=0.35):
+    """Deterministically corrupt ~rate of the corpus: truncation,
+    bit flips, and splices of bytes from sibling datums."""
+    rng = random.Random(seed)
+    out = []
+    for j, d in enumerate(datums):
+        if rng.random() >= rate or not d:
+            out.append(d)
+            continue
+        kind = rng.randrange(3)
+        b = bytearray(d)
+        if kind == 0:  # truncate
+            b = b[: rng.randrange(len(b))]
+        elif kind == 1:  # bit-flip 1..3 bytes
+            for _ in range(rng.randint(1, 3)):
+                i = rng.randrange(len(b))
+                b[i] ^= 1 << rng.randrange(8)
+        else:  # splice a window from another datum (or noise)
+            src = datums[rng.randrange(len(datums))] or b"\xff\x80\x7f"
+            a = rng.randrange(len(b))
+            w = rng.randint(1, min(8, len(src)))
+            s = rng.randrange(max(len(src) - w, 0) + 1)
+            b[a : a + w] = src[s : s + w]
+        out.append(bytes(b))
+    return out
+
+
+def oracle_verdicts(datums, entry):
+    """Per-record accept(True)/reject(False) through the FULL oracle
+    (wire decode + Arrow build): a wire-valid datum whose VALUES cannot
+    build (invalid uuid text, over-precision decimal) is a reject too.
+    Reject = ValueError family (MalformedAvro / ArrowInvalid / value
+    errors); anything else would be a crash and propagates."""
+    verdicts = []
+    for d in datums:
+        try:
+            decode_to_record_batch([d], entry.ir, entry.arrow_schema)
+            verdicts.append(True)
+        except (ValueError, OverflowError):
+            verdicts.append(False)
+    return verdicts
+
+
+def _check_schema_seed(schema, seed, codec=None):
+    entry = get_or_parse_schema(schema)
+    datums = random_datums(entry.ir, 40, seed=seed + 5000)
+    corpus = mutate_corpus(datums, seed)
+    codec = codec or NativeHostCodec(entry.ir, entry.arrow_schema)
+    want = oracle_verdicts(corpus, entry)
+
+    # (a)+(b): per-record agreement; any exception outside the
+    # ValueError family fails the test (crash-freedom is the whole
+    # point — the VM decodes borrowed spans)
+    for j, d in enumerate(corpus):
+        try:
+            got = codec.decode([d])
+            accepted = True
+        except (ValueError, OverflowError):
+            accepted = False
+        assert accepted == want[j], (
+            f"seed {seed} record {j}: native={'accept' if accepted else 'reject'} "
+            f"oracle={'accept' if want[j] else 'reject'} datum={d!r}"
+        )
+        if accepted:
+            ref = decode_to_record_batch([d], entry.ir, entry.arrow_schema)
+            assert got.equals(ref), f"seed {seed} record {j} decode mismatch"
+
+    # (c): skip-policy parity — fallback vs native byte-identical
+    # survivors and identical quarantine indices
+    import os
+
+    os.environ["PYRUHVRO_TPU_NO_NATIVE"] = "1"
+    try:
+        fb, fe = p.deserialize_array(
+            corpus, schema, backend="host", on_error="skip",
+            return_errors=True)
+    finally:
+        del os.environ["PYRUHVRO_TPU_NO_NATIVE"]
+    nb, ne = p.deserialize_array(
+        corpus, schema, backend="host", on_error="skip",
+        return_errors=True)
+    assert [q.index for q in fe] == [q.index for q in ne] == [
+        j for j, ok in enumerate(want) if not ok
+    ]
+    assert fb.equals(nb), f"seed {seed}: surviving rows differ"
+
+
+@pytest.mark.parametrize("seed", range(40, 46))
+def test_mutation_fuzz_quick(seed):
+    _check_schema_seed(random_schema(seed), seed)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("seed", range(46, 76))
+def test_mutation_fuzz_full(seed):
+    _check_schema_seed(random_schema(seed), seed)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("seed", range(40, 50))
+def test_mutation_fuzz_specialized(seed, monkeypatch):
+    """The same sweep through the schema-SPECIALIZED C++ engines
+    (straight-line generated code; one g++ build per schema)."""
+    monkeypatch.setenv("PYRUHVRO_TPU_SPECIALIZE_ROWS", "0")
+    schema = random_schema(seed)
+    entry = get_or_parse_schema(schema)
+    codec = NativeHostCodec(entry.ir, entry.arrow_schema)
+    codec._maybe_specialize(1)
+    if codec._spec is None:
+        pytest.skip("specializer unavailable")
+    _check_schema_seed(schema, seed, codec=codec)
+
+
+def test_mutation_fuzz_device_leg():
+    """Device tier accept-vs-reject + skip parity on a fixed flat schema
+    (one XLA compile per shape bucket keeps this cheap)."""
+    entry = get_or_parse_schema(FLAT_SCHEMA)
+    datums = random_datums(entry.ir, 32, seed=77)
+    corpus = mutate_corpus(datums, 77, rate=0.4)
+    want = oracle_verdicts(corpus, entry)
+
+    db, de = p.deserialize_array(
+        corpus, FLAT_SCHEMA, backend="tpu", on_error="skip",
+        return_errors=True)
+    assert [q.index for q in de] == [
+        j for j, ok in enumerate(want) if not ok
+    ]
+    nb, ne = p.deserialize_array(
+        corpus, FLAT_SCHEMA, backend="host", on_error="skip",
+        return_errors=True)
+    assert [q.index for q in de] == [q.index for q in ne]
+    assert db.equals(nb), "device vs host surviving rows differ"
