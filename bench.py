@@ -214,10 +214,45 @@ def _run_case(op, schema, datums, backend, chunks, reps, details,
          f"{dt * 1e3:.3f} ms = {rec_s:,.0f} rec/s "
          f"({rec_s / base:.3f}x baseline)"
          + (f" | compiles={snap.get(mkey + '.compiles', 0):.0f} "
-            f"launch={snap.get(mkey + '.launch_s', 0) * 1e3:.1f}ms "
+            f"compile={snap.get('device.compile_s', 0) * 1e3:.1f}ms "
+            f"launch={snap.get('device.launch_s', 0) * 1e3:.1f}ms "
             f"d2h={snap.get(mkey + '.d2h_bytes', 0) / 1e6:.2f}MB"
             if backend == "tpu" else ""))
     last_span = tsnap["spans"][-1] if tsnap["spans"] else None
+    # device-tier section (ISSUE 5): the compile-vs-launch split proves
+    # the headline medians exclude first-compile warmup (compiles happen
+    # during the untimed warmup rep; the timed reps are cache hits), and
+    # the jit-cache / transfer / retry numbers ride into every BENCH_*
+    # snapshot so a perf regression arrives with its routing evidence
+    device = None
+    if any(k.startswith("device.") for k in snap):
+        cache_det = (tsnap.get("device") or {}).get("jit_cache") or {}
+        device = {
+            "compile_s": round(snap.get("device.compile_s", 0.0), 6),
+            "launch_s": round(snap.get("device.launch_s", 0.0), 6),
+            "pipeline_s": round(snap.get("device.pipeline_s", 0.0), 6),
+            "jit_cache": {
+                "hits": int(snap.get("device.jit_cache.hits", 0)),
+                "misses": int(snap.get("device.jit_cache.misses", 0)),
+                "executables": len(cache_det),
+            },
+            "h2d_bytes": int(snap.get("device.h2d_bytes", 0)),
+            "d2h_bytes": int(snap.get("device.d2h_bytes", 0)),
+            "retries": int(snap.get("device.retries", 0)),
+            "recompile_storms": int(
+                snap.get("device.recompile_storm", 0)),
+            # median reps are post-warmup: every timed rep that hit the
+            # jit cache ran compile-free
+            "warmup_excludes_compile": (
+                snap.get("device.jit_cache.hits", 0) > 0
+            ),
+        }
+        _log(f"[bench] {label or ''}{op}[{backend}] device split: "
+             f"compile {device['compile_s'] * 1e3:.1f} ms "
+             f"(warmup) / launch {device['launch_s'] * 1e3:.1f} ms, "
+             f"cache {device['jit_cache']['misses']} miss "
+             f"{device['jit_cache']['hits']} hit, "
+             f"retries {device['retries']}")
     # native-profiler decomposition (only non-empty when the run was
     # started with PYRUHVRO_TPU_NATIVE_PROF=1): how much of the VM phase
     # the per-opcode self-times account for
@@ -234,6 +269,7 @@ def _run_case(op, schema, datums, backend, chunks, reps, details,
              f"{native_prof['coverage_of_vm'] * 100:.1f}% of host.vm_s")
     details["results"].append({
         **({"native_prof": native_prof} if native_prof else {}),
+        **({"device": device} if device else {}),
         "op": op, "backend": backend, "rows": rows, "chunks": chunks,
         "schema": label or "kafka", "seconds": dt, "records_per_s": rec_s,
         "vs_baseline": rec_s / base,

@@ -162,12 +162,14 @@ class DeviceCodec:
                 from .pallas_decode import PallasKernelDecoder
 
                 self.decoder = PallasKernelDecoder(
-                    entry.ir, interpret=pallas_flag == "interpret"
+                    entry.ir, interpret=pallas_flag == "interpret",
+                    fingerprint=entry.fingerprint,
                 )
             except UnsupportedOnDevice:
                 pass  # nested repetition: the XLA pipeline serves it
         if self.decoder is None:
-            self.decoder = DeviceDecoder(entry.ir)
+            self.decoder = DeviceDecoder(entry.ir,
+                                         fingerprint=entry.fingerprint)
         self._encoder = None
         self._sharded = None  # lazily: ShardedDecoder | False (single-chip)
         # probe the backend now: a missing/broken device must fail at
@@ -312,7 +314,10 @@ class DeviceCodec:
             from .encode import DeviceEncoder
 
             try:
-                self._encoder = DeviceEncoder(self.ir, self.arrow_schema)
+                self._encoder = DeviceEncoder(
+                    self.ir, self.arrow_schema,
+                    fingerprint=self.entry.fingerprint,
+                )
             except UnsupportedOnDevice:
                 # encode subset narrower than decode's for this schema:
                 # serve serialize from the host path (silent fallback,

@@ -33,7 +33,7 @@ import numpy as np
 import time
 
 from ..fallback.io import MalformedAvro, malformed_record
-from ..runtime import metrics, telemetry
+from ..runtime import device_obs, metrics, telemetry
 from ..runtime.pack import bucket_len, concat_records
 from .fieldprog import ROWS, Program, lower
 from .varint import ERR_ITEM_OVERFLOW, ERR_NAMES, ERR_SLUGS
@@ -135,6 +135,20 @@ def unpack_launch_input(jnp, lax, buf, W: int, R: int):
     n = lax.bitcast_convert_type(buf[W + 2 * R], jnp.int32)
     return words, starts, lengths, n
 
+def _bucket_label(R: int, B: int, item_caps=(), tot_caps=(),
+                  compact: bool = True) -> str:
+    """Human-readable shape-bucket id for the jit-cache registry (one
+    label per compiled executable)."""
+    label = f"R{R},B{B}"
+    if len(item_caps) > 1:
+        label += ",i" + "/".join(str(c) for c in item_caps[1:])
+    if len(tot_caps) > 1:
+        label += ",t" + "/".join(str(c) for c in tot_caps[1:])
+    if not compact:
+        label += ",full"
+    return label
+
+
 _DEFAULT_ITEM_CAP = 8
 _DEFAULT_TOT_CAP = 8
 # per-record item-slot ceiling: beyond this the strided buffers would not
@@ -191,13 +205,17 @@ def _enable_persistent_cache(jax) -> None:
 class DeviceDecoder:
     """Per-schema decode pipeline with compiled-kernel caches."""
 
-    def __init__(self, ir, backend: str = None):
+    def __init__(self, ir, backend: str = None,
+                 fingerprint: str = None):
         import jax  # deferred: importing pyruhvro_tpu must stay JAX-free
 
         _enable_persistent_cache(jax)
         self._jax = jax
         self.prog: Program = lower(ir)
         self.backend = backend
+        # schema id for the jit-cache registry / recompile-churn guard
+        # (codec.py passes the SchemaEntry fingerprint down)
+        self.fingerprint = fingerprint or "?"
         self._pipe_cache: Dict[tuple, tuple] = {}
         self._err_cache: Dict[tuple, object] = {}
         self._item_caps: List[int] = [0] + [
@@ -464,7 +482,18 @@ class DeviceDecoder:
         def packed(buf):
             return pipeline(*unpack_launch_input(jnp, lax, buf, W, R))
 
-        pair = (self._jax.jit(packed), layout)
+        # jit-cache telemetry (ISSUE 5): each cache entry is one
+        # executable; the wrapper splits its first call into an explicit
+        # lower+compile (device.compile_s) and times every later call as
+        # device.launch_s, feeding the per-(fingerprint, bucket) registry
+        # and the recompile-churn guard
+        fn = device_obs.InstrumentedJit(
+            self._jax, self._jax.jit(packed), kind="decode.pipeline",
+            bucket=_bucket_label(R, B, item_caps, tot_caps,
+                                 compact_strings),
+            fingerprint=self.fingerprint, family="decode",
+        )
+        pair = (fn, layout)
         with self._lock:
             self._pipe_cache[key] = pair
         return pair
@@ -475,10 +504,16 @@ class DeviceDecoder:
         key = (R, B, item_caps)
         fn = self._err_cache.get(key)
         if fn is None:
-            fn = self._jax.jit(
-                lambda words, starts, lengths, n: self._trace_walk(
-                    R, item_caps, words, starts, lengths, n
-                )["#err"]
+            fn = device_obs.InstrumentedJit(
+                self._jax,
+                self._jax.jit(
+                    lambda words, starts, lengths, n: self._trace_walk(
+                        R, item_caps, words, starts, lengths, n
+                    )["#err"]
+                ),
+                kind="decode.err",
+                bucket=_bucket_label(R, B, item_caps),
+                fingerprint=self.fingerprint, family="decode",
             )
             with self._lock:
                 self._err_cache[key] = fn
@@ -515,9 +550,10 @@ class DeviceDecoder:
             from ..fallback.decoder import decode_to_record_batch
             from ..schema.arrow_map import to_arrow_schema
 
-            sample = decode_to_record_batch(
-                data[:k], prog.ir, to_arrow_schema(prog.ir)
-            )
+            with telemetry.phase("device.seed_s", rows=k):
+                sample = decode_to_record_batch(
+                    data[:k], prog.ir, to_arrow_schema(prog.ir)
+                )
         except Exception:
             return
         for rid in need:
@@ -595,7 +631,17 @@ class DeviceDecoder:
     def decode_to_columns(self, data: Sequence[bytes]):
         """Run the pipeline; returns ``(host_columns, n, meta)`` where meta
         carries per-region item totals and the raw datum bytes for the
-        host-side assembly."""
+        host-side assembly.
+
+        ``device.pipeline_s`` spans the whole device phase; its children
+        (pack → h2d → compile/launch → d2h, plus seed/retry rungs)
+        decompose it — the ISSUE 5 acceptance contract asserts >= 90%
+        coverage on the kafka 10k run."""
+        with telemetry.phase("device.pipeline_s", rows=len(data),
+                             op="decode"):
+            return self._decode_to_columns(data)
+
+    def _decode_to_columns(self, data: Sequence[bytes]):
         jax = self._jax
         n = len(data)
         with telemetry.phase("decode.pack_s", rows=n):
@@ -614,6 +660,7 @@ class DeviceDecoder:
         with telemetry.phase("decode.h2d_s", bytes=packed.nbytes):
             packed_d = jax.device_put(packed)
         metrics.inc("decode.h2d_bytes", packed.nbytes)
+        metrics.inc("device.h2d_bytes", packed.nbytes)
 
         prog = self.prog
         host = None
@@ -622,35 +669,30 @@ class DeviceDecoder:
         for _attempt in range(24):
             item_caps, tot_caps = self.caps_snapshot(R)
             compact = (R, B) not in self._str_full
-            fresh = (
-                (R, B, item_caps, tot_caps, compact)
-                not in self._pipe_cache
-            )
             fn, layout = self._pipeline_fn(R, B, item_caps, tot_caps,
                                            compact)
-            # async dispatch; the device_get below is the ONLY
-            # synchronization of the call — an intermediate
-            # block_until_ready would cost a second full round trip on a
-            # high-latency interconnect (BENCH_NOTES.md). launch_s is
-            # therefore dispatch-only; d2h_s carries the wait.
-            t0 = time.perf_counter()
+            # the wrapper splits device.compile_s (first call per shape
+            # bucket, explicit lower+compile) from device.launch_s
+            # (block_until_ready-bounded unless behind a remote
+            # interconnect — device_obs.sync_mode); d2h_s carries any
+            # remaining wait
             res = fn(packed_d)
-            dt = time.perf_counter() - t0
-            if fresh:  # first call pays trace+XLA-compile; track apart
-                metrics.inc("decode.compiles")
-                telemetry.observe("decode.compile_launch_s", dt,
-                                  attempt=_attempt)
-            else:
-                metrics.inc("decode.launches")
-                telemetry.observe("decode.launch_s", dt, attempt=_attempt)
             with telemetry.phase("decode.d2h_s"):
                 blob = np.asarray(jax.device_get(res))
             metrics.inc("decode.d2h_bytes", blob.nbytes)
+            metrics.inc("device.d2h_bytes", blob.nbytes)
             host = split_blob(blob, layout)
             if compact and "#red:strfit" in host and not host["#red:strfit"][0]:
                 # a string overflowed the compact descriptor budget:
                 # remember and relaunch this bucket full-width
                 self._str_full.add((R, B))
+                metrics.inc("device.retries")
+                telemetry.observe(
+                    "device.retry_s", 0.0,
+                    reason="str_descriptor_overflow", attempt=_attempt,
+                    capacity=_bucket_label(R, B, item_caps, tot_caps,
+                                           compact),
+                )
                 continue
             red_max = {
                 rid: int(host["#red:max:" + path][0])
@@ -662,10 +704,25 @@ class DeviceDecoder:
                 for rid, path in enumerate(prog.regions)
                 if rid != ROWS
             }
+            t0 = time.perf_counter()
             if not self.grow_caps(R, item_caps, tot_caps, red_max, red_sum):
                 break
+            # each retry-ladder rung is a child span carrying WHY the
+            # relaunch happened and the capacity that proved too small
+            metrics.inc("device.retries")
+            telemetry.observe(
+                "device.retry_s", time.perf_counter() - t0,
+                reason="cap_growth", attempt=_attempt,
+                capacity=_bucket_label(R, B, item_caps, tot_caps, compact),
+                need_items=max(red_max.values(), default=0),
+                need_total=max(red_sum.values(), default=0),
+            )
         else:
             raise MalformedAvro("array/map item capacity did not converge")
+
+        # per-device memory watermarks where the backend exposes them
+        # (TPU/GPU memory_stats(); graceful no-op on CPU)
+        device_obs.note_memory(jax)
 
         host = self.expand_host(host)
         if host["#red:err"][0]:

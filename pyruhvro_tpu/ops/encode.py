@@ -1301,7 +1301,8 @@ def unpack_input_entries(jnp, lax, buf, entries: tuple) -> Dict[str, object]:
 class DeviceEncoder:
     """Per-schema encode pipeline: one jitted launch per (shape-bucket)."""
 
-    def __init__(self, ir: Record, arrow_schema: pa.Schema):
+    def __init__(self, ir: Record, arrow_schema: pa.Schema,
+                 fingerprint: str = None):
         import jax  # deferred, like DeviceDecoder
 
         from .decode import _enable_persistent_cache
@@ -1310,6 +1311,7 @@ class DeviceEncoder:
         self._jax = jax
         self.ir = ir
         self.arrow_schema = arrow_schema
+        self.fingerprint = fingerprint or "?"  # jit-cache registry id
         self.prog = lower_encoder(ir)  # raises UnsupportedOnDevice
         self._packed_cache: Dict[tuple, object] = {}
 
@@ -1350,7 +1352,20 @@ class DeviceEncoder:
         def run_packed(buf):
             return run(unpack_input_entries(jnp, lax, buf, entries), cap)
 
-        fn = self._jax.jit(run_packed)
+        import hashlib
+
+        from ..runtime import device_obs
+
+        total = sum(np.dtype(dt).itemsize * ln for _k, dt, ln in entries)
+        # the short entries digest keeps the registry bucket unique per
+        # executable: two different input layouts can share (total, cap)
+        # but are distinct compiles (the cache key is (entries, cap))
+        eh = hashlib.sha1(repr(entries).encode()).hexdigest()[:6]
+        fn = device_obs.InstrumentedJit(
+            self._jax, self._jax.jit(run_packed), kind="encode.pipeline",
+            bucket=f"in{total},cap{cap},e{eh}",
+            fingerprint=self.fingerprint, family="encode",
+        )
         self._packed_cache[key] = fn
         return fn
 
@@ -1358,13 +1373,17 @@ class DeviceEncoder:
         """Encode every row as one Avro datum → BinaryArray whose value
         buffer is the device output, zero-copy
         (≙ ``serialize_chunk``, ``fast_encode.rs:27-52``)."""
-        import time
-
-        from ..runtime import metrics, telemetry
+        from ..runtime import telemetry
 
         n = batch.num_rows
         if n == 0:
             return pa.array([], pa.binary())
+        with telemetry.phase("device.pipeline_s", rows=n, op="encode"):
+            return self._encode(batch, n)
+
+    def _encode(self, batch: pa.RecordBatch, n: int) -> pa.Array:
+        from ..runtime import device_obs, metrics, telemetry
+
         with telemetry.phase("encode.extract_s", rows=n):
             dv, bound = extract_batch(self.prog, batch, self.ir)
         if bound >= (1 << 30):
@@ -1376,25 +1395,22 @@ class DeviceEncoder:
         cap = bucket_len(bound, minimum=64)
         jax = self._jax
         entries = input_entries(dv)
-        fresh = (entries, cap) not in self._packed_cache
         packed = np.concatenate(
             [dv[k].view(np.uint8) for k, _dt, _ln in entries]
         )
         metrics.inc("encode.h2d_bytes", packed.nbytes)
+        metrics.inc("device.h2d_bytes", packed.nbytes)
         fn = self._packed_fn(entries, cap)
-        # async dispatch; the device_get below is the single sync point
-        t0 = time.perf_counter()
-        res = fn(jax.device_put(packed))
-        dt = time.perf_counter() - t0
-        if fresh:
-            metrics.inc("encode.compiles")
-            telemetry.observe("encode.compile_launch_s", dt)
-        else:
-            metrics.inc("encode.launches")
-            telemetry.observe("encode.launch_s", dt)
+        with telemetry.phase("encode.h2d_s", bytes=packed.nbytes):
+            packed_d = jax.device_put(packed)
+        # the wrapper records device.compile_s (first call per shape
+        # bucket) vs device.launch_s; d2h carries any remaining wait
+        res = fn(packed_d)
         with telemetry.phase("encode.d2h_s"):
             blob = np.asarray(jax.device_get(res))
         metrics.inc("encode.d2h_bytes", blob.nbytes)
+        metrics.inc("device.d2h_bytes", blob.nbytes)
+        device_obs.note_memory(jax)
         R = dv["#active:0"].shape[0]
         sizes = blob[cap : cap + 4 * R].view(np.int32)[:n]
         offsets = np.zeros(n + 1, np.int32)

@@ -53,7 +53,7 @@ from typing import Dict, Sequence, Tuple
 import numpy as np
 
 from ..fallback.io import MalformedAvro
-from ..runtime import metrics, telemetry
+from ..runtime import device_obs, metrics, telemetry
 from ..runtime.pack import bucket_len, concat_records
 from . import UnsupportedOnDevice
 from .fieldprog import ROWS, Program, _Ctx, lower
@@ -110,10 +110,12 @@ class PallasKernelDecoder:
     assembly and the differential tests are shared verbatim.
     """
 
-    def __init__(self, ir, interpret: bool = False):
+    def __init__(self, ir, interpret: bool = False,
+                 fingerprint: str = None):
         import jax  # deferred, like the rest of the package
 
         self._jax = jax
+        self.fingerprint = fingerprint or "?"  # jit-cache registry id
         self.prog = lower(ir)
         if not pallas_supported(self.prog):
             raise UnsupportedOnDevice(
@@ -266,7 +268,13 @@ class PallasKernelDecoder:
         with self._lock:
             fn = self._cache.get(key)
             if fn is None:
-                fn = self._build(grid_r, tile_r, BW, caps)
+                fn = device_obs.InstrumentedJit(
+                    self._jax, self._build(grid_r, tile_r, BW, caps),
+                    kind="decode.pallas",
+                    bucket=f"g{grid_r},tile{tile_r},BW{BW},"
+                           f"caps{'/'.join(map(str, caps))}",
+                    fingerprint=self.fingerprint, family="decode",
+                )
                 self._cache[key] = fn
         return fn
 
@@ -276,6 +284,11 @@ class PallasKernelDecoder:
         """Row-padded pack → kernel (item-cap retry ladder) → host
         compaction → host columns (same contract as
         ``DeviceDecoder.decode_to_columns``)."""
+        with telemetry.phase("device.pipeline_s", rows=len(data),
+                             op="decode", kernel="pallas"):
+            return self._decode_to_columns(data)
+
+    def _decode_to_columns(self, data: Sequence[bytes]):
         jax = self._jax
         n = len(data)
         with telemetry.phase("decode.pack_s", rows=n, kernel="pallas"):
@@ -334,14 +347,15 @@ class PallasKernelDecoder:
             if R != prev_R:
                 padded, lens, act = pack(R)
                 prev_R = R
-                with telemetry.phase("decode.h2d_s"):
+                h2d_nbytes = padded.nbytes + lens.nbytes + act.nbytes
+                with telemetry.phase("decode.h2d_s", bytes=h2d_nbytes):
                     args = (jax.device_put(padded.view(np.uint32)),
                             jax.device_put(lens), jax.device_put(act))
-                metrics.inc("decode.h2d_bytes",
-                            padded.nbytes + lens.nbytes + act.nbytes)
+                metrics.inc("decode.h2d_bytes", h2d_nbytes)
+                metrics.inc("device.h2d_bytes", h2d_nbytes)
             fn = self._fn(grid_r, tile_r, BW, caps)
-            with telemetry.phase("decode.launch_s", kernel="pallas"):
-                dev_outs = fn(*args)
+            # device.compile_s / device.launch_s split by the wrapper
+            dev_outs = fn(*args)
             err_np = np.asarray(jax.device_get(dev_outs[err_i]))
             if not (err_np[:n] & ERR_ITEM_OVERFLOW).any():
                 break
@@ -350,6 +364,11 @@ class PallasKernelDecoder:
                     f"array/map items exceed the pallas cap ladder "
                     f"({_MAX_CAP}/record); use the XLA pipeline"
                 )
+            metrics.inc("device.retries")
+            telemetry.observe(
+                "device.retry_s", 0.0, reason="item_cap_overflow",
+                capacity=f"caps{'/'.join(map(str, caps))}",  # too small
+            )
             caps = tuple(0 if c == 0 else c * 2 for c in caps)
         self._caps = caps
         with telemetry.phase("decode.d2h_s"):
@@ -359,6 +378,8 @@ class PallasKernelDecoder:
                 for i, v in enumerate(dev_outs)
             ]
         metrics.inc("decode.d2h_bytes", sum(v.nbytes for v in outs))
+        metrics.inc("device.d2h_bytes", sum(v.nbytes for v in outs))
+        device_obs.note_memory(jax)
 
         host = dict(zip(self.out_keys, outs))
         err = host.pop("#err")[:n]

@@ -17,6 +17,7 @@ reductions are max-reduced across shards on the host.
 from __future__ import annotations
 
 import threading
+import time
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -26,6 +27,7 @@ from ..fallback.io import MalformedAvro, malformed_record
 from ..ops.decode import (
     BatchTooLarge,
     DeviceDecoder,
+    _bucket_label,
     pack_launch_input,
     pad_views,
     split_blob,
@@ -33,6 +35,7 @@ from ..ops.decode import (
 )
 from ..ops.fieldprog import ROWS
 from ..ops.varint import ERR_ITEM_OVERFLOW, ERR_NAMES, ERR_SLUGS
+from ..runtime import device_obs, metrics, telemetry
 from ..runtime.chunking import chunk_bounds
 from ..runtime.pack import bucket_len, concat_records
 
@@ -126,7 +129,13 @@ class ShardedDecoder:
             fn = smap(per_shard, check_vma=False, **kwargs)
         except TypeError:
             fn = smap(per_shard, check_rep=False, **kwargs)
-        pair = (jax.jit(fn), layout)
+        inst = device_obs.InstrumentedJit(
+            jax, jax.jit(fn), kind="decode.sharded",
+            bucket=f"D{self.D}," + _bucket_label(R, B, item_caps,
+                                                 tot_caps, compact),
+            fingerprint=self.base.fingerprint, family="decode",
+        )
+        pair = (inst, layout)
         with self._lock:
             self._cache[key] = pair
         return pair
@@ -137,7 +146,17 @@ class ShardedDecoder:
         """Decode into exactly ``D`` chunks (reference slicing: even, with
         the remainder in the LAST chunk). Returns a list of
         ``(host_columns, n_rows, meta)`` per chunk — the same triple the
-        single-device path produces, ready for ``arrow_build``."""
+        single-device path produces, ready for ``arrow_build``.
+
+        Observability mirrors the single-device pipeline (ISSUE 5): one
+        ``device.pipeline_s`` span whose children are the pack, the
+        sharded h2d, each ladder rung's compile/launch, and the [D, blob]
+        d2h."""
+        with telemetry.phase("device.pipeline_s", rows=len(data),
+                             op="decode", shards=self.D):
+            return self._decode_to_chunk_columns(data)
+
+    def _decode_to_chunk_columns(self, data: Sequence[bytes]):
         n_all = len(data)
         bounds = chunk_bounds(n_all, self.D)
         # fewer records than devices: pad with empty shards so the launch
@@ -145,10 +164,11 @@ class ShardedDecoder:
         while len(bounds) < self.D:
             bounds.append((n_all, n_all))
 
-        packs = []
-        for a, b in bounds:
-            flat, offsets = concat_records(data[a:b])
-            packs.append((flat, offsets, b - a))
+        with telemetry.phase("decode.pack_s", rows=n_all):
+            packs = []
+            for a, b in bounds:
+                flat, offsets = concat_records(data[a:b])
+                packs.append((flat, offsets, b - a))
         max_total = max(int(p[1][-1]) for p in packs)
         max_rows = max(p[2] for p in packs)
         if max_total > (1 << 30):
@@ -178,19 +198,33 @@ class ShardedDecoder:
         spec = jax.sharding.NamedSharding(
             self.mesh, jax.sharding.PartitionSpec("chunks")
         )
-        buf_d = jax.device_put(buf, spec)
+        with telemetry.phase("decode.h2d_s", bytes=buf.nbytes):
+            buf_d = jax.device_put(buf, spec)
+        metrics.inc("decode.h2d_bytes", buf.nbytes)
+        metrics.inc("device.h2d_bytes", buf.nbytes)
         hosts = None
         for _attempt in range(24):
             item_caps, tot_caps = self.base.caps_snapshot(R)
             compact = (R, B) not in self.base._str_full
             fn, layout = self._sharded_fn(R, B, item_caps, tot_caps,
                                           compact)
-            blob = np.asarray(jax.device_get(fn(buf_d)))
+            res = fn(buf_d)  # compile/launch split by the wrapper
+            with telemetry.phase("decode.d2h_s"):
+                blob = np.asarray(jax.device_get(res))
+            metrics.inc("decode.d2h_bytes", blob.nbytes)
+            metrics.inc("device.d2h_bytes", blob.nbytes)
             hosts = [split_blob(blob[d], layout) for d in range(D)]
             if compact and "#red:strfit" in hosts[0] and not all(
                 h["#red:strfit"][0] for h in hosts
             ):
                 self.base._str_full.add((R, B))
+                metrics.inc("device.retries")
+                telemetry.observe(
+                    "device.retry_s", 0.0,
+                    reason="str_descriptor_overflow", attempt=_attempt,
+                    capacity=_bucket_label(R, B, item_caps, tot_caps,
+                                           compact),
+                )
                 continue
             red_max = {}
             red_sum = {}
@@ -205,11 +239,21 @@ class ShardedDecoder:
                 red_sum[rid] = max(
                     int(h["#red:sum:" + path][0]) for h in hosts
                 )
+            t0 = time.perf_counter()
             if not self.base.grow_caps(R, item_caps, tot_caps,
                                        red_max, red_sum):
                 break
+            metrics.inc("device.retries")
+            telemetry.observe(
+                "device.retry_s", time.perf_counter() - t0,
+                reason="cap_growth", attempt=_attempt,
+                capacity=_bucket_label(R, B, item_caps, tot_caps, compact),
+                need_items=max(red_max.values(), default=0),
+                need_total=max(red_sum.values(), default=0),
+            )
         else:
             raise MalformedAvro("array/map item capacity did not converge")
+        device_obs.note_memory(jax)
 
         for d, h in enumerate(hosts):
             if h["#red:err"][0]:

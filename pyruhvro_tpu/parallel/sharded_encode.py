@@ -26,6 +26,7 @@ from ..ops.encode import (
     input_entries,
     unpack_input_entries,
 )
+from ..runtime import device_obs, metrics, telemetry
 from ..runtime.chunking import chunk_bounds
 from ..runtime.pack import bucket_len
 from .sharded import _shard_map, chunk_mesh
@@ -87,13 +88,27 @@ class ShardedEncoder:
             fn = smap(per_shard, check_vma=False, **kwargs)
         except TypeError:
             fn = smap(per_shard, check_rep=False, **kwargs)
-        fn = jax.jit(fn)
+        import hashlib
+
+        eh = hashlib.sha1(repr(entries).encode()).hexdigest()[:6]
+        total = sum(np.dtype(dt).itemsize * ln for _k, dt, ln in entries)
+        fn = device_obs.InstrumentedJit(
+            jax, jax.jit(fn), kind="encode.sharded",
+            bucket=f"D{self.D},in{total},cap{cap},e{eh}",
+            fingerprint=self.base.fingerprint, family="encode",
+        )
         with self._lock:
             self._cache[key] = fn
         return fn
 
     def encode(self, batch: pa.RecordBatch) -> List[pa.Array]:
-        """Full sharded encode → one BinaryArray per mesh chunk."""
+        """Full sharded encode → one BinaryArray per mesh chunk
+        (``device.pipeline_s``-spanned like every other device entry)."""
+        with telemetry.phase("device.pipeline_s", rows=batch.num_rows,
+                             op="encode", shards=self.D):
+            return self._encode(batch)
+
+    def _encode(self, batch: pa.RecordBatch) -> List[pa.Array]:
         jax = self._jax
         n_all = batch.num_rows
         bounds = chunk_bounds(n_all, self.D)
@@ -101,11 +116,12 @@ class ShardedEncoder:
             bounds.append((n_all, n_all))
 
         prog, ir = self.base.prog, self.base.ir
-        dvs, bound = [], 16
-        for a, b in bounds:
-            dv, bd = extract_batch(prog, batch.slice(a, b - a), ir)
-            dvs.append(dv)
-            bound = max(bound, bd)
+        with telemetry.phase("encode.extract_s", rows=n_all):
+            dvs, bound = [], 16
+            for a, b in bounds:
+                dv, bd = extract_batch(prog, batch.slice(a, b - a), ir)
+                dvs.append(dv)
+                bound = max(bound, bd)
         cap = bucket_len(bound, minimum=64)
 
         # unify per-chunk shapes to the max bucket, then stack [D, ...];
@@ -134,9 +150,16 @@ class ShardedEncoder:
             self.mesh, jax.sharding.PartitionSpec("chunks")
         )
         fn = self._sharded_fn(entries, cap)
-        blob = np.asarray(
-            jax.device_get(fn(jax.device_put(packed, spec)))
-        )
+        with telemetry.phase("encode.h2d_s", bytes=packed.nbytes):
+            packed_d = jax.device_put(packed, spec)
+        metrics.inc("encode.h2d_bytes", packed.nbytes)
+        metrics.inc("device.h2d_bytes", packed.nbytes)
+        res = fn(packed_d)  # compile/launch split by the wrapper
+        with telemetry.phase("encode.d2h_s"):
+            blob = np.asarray(jax.device_get(res))
+        metrics.inc("encode.d2h_bytes", blob.nbytes)
+        metrics.inc("device.d2h_bytes", blob.nbytes)
+        device_obs.note_memory(jax)
 
         out: List[pa.Array] = []
         R = stacked["#active:0"].shape[1]

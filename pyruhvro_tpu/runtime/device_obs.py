@@ -1,0 +1,390 @@
+"""Device-tier observability: the XLA analog of the native profiler.
+
+PR 3 made the host tier's opaque ``host.vm_s`` decompose into per-opcode
+self times; this module does the same for the device tier's opaque
+"first call was slow" — every jitted entry the device pipelines build is
+wrapped in :class:`InstrumentedJit`, which splits
+
+* ``device.compile_s`` — the first lower+compile per (schema
+  fingerprint, shape bucket), measured explicitly via
+  ``jit.lower(args).compile()`` where the AOT path works, or as
+  first-call wall time otherwise (``mode="first_call"`` on the span);
+* ``device.launch_s`` — every post-warmup execution,
+  ``block_until_ready``-bounded by default so the number is the real
+  device time, not just the async dispatch (see :func:`sync_mode`);
+
+and keeps a **jit-cache registry** keyed by (schema fingerprint, kind,
+shape bucket): ``device.jit_cache.hits`` / ``device.jit_cache.misses``
+flat counters plus per-executable detail (compiles, launches, seconds,
+XLA ``cost_analysis()`` flops / bytes-accessed) exported through
+``telemetry.snapshot()["device"]``.
+
+Also here:
+
+* the **recompile-churn guard** (:func:`note_compile`): distinct
+  compiles per schema fingerprint are counted in a sliding window
+  (``PYRUHVRO_TPU_RECOMPILE_WINDOW`` seconds, default 60); crossing
+  ``PYRUHVRO_TPU_RECOMPILE_STORM`` (default 8) increments
+  ``device.recompile_storm`` and auto-dumps the flight recorder exactly
+  like a quarantine storm does — recompile churn is the device tier's
+  poison message (VERDICT r03: per-shape-bucket churn silently ate the
+  encode path's win);
+* **memory watermarks** (:func:`note_memory`): per-device
+  ``memory_stats()`` where the backend exposes them (TPU/GPU), a
+  graceful no-op on CPU.
+
+Sync policy (``PYRUHVRO_TPU_DEVICE_SYNC`` = ``1`` / ``0`` / unset):
+bounding a launch costs one extra synchronization, which is free on a
+co-located device but a full RTT behind a remote device tunnel
+(BENCH_NOTES.md: ~65 ms). Default (unset) is therefore *auto*: bounded
+launches, except when telemetry is disabled or the one-time interconnect
+probe measured a remote transport — there the d2h phase keeps carrying
+the wait, exactly the pre-PR-5 shape.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, Optional, Tuple
+
+from . import metrics, telemetry
+
+__all__ = [
+    "InstrumentedJit",
+    "note_compile",
+    "note_memory",
+    "snapshot",
+    "reset",
+    "sync_mode",
+]
+
+_lock = threading.Lock()
+# (fingerprint, kind, bucket) -> per-executable stats
+_registry: Dict[Tuple[str, str, str], Dict[str, Any]] = {}
+# device id -> last-seen memory_stats watermarks
+_memory: Dict[str, Dict[str, Any]] = {}
+# fingerprint -> monotonic timestamps of recent compiles (churn window)
+_compile_log: Dict[str, deque] = {}
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
+
+
+def churn_window_s() -> float:
+    return max(0.001, _env_float("PYRUHVRO_TPU_RECOMPILE_WINDOW", 60.0))
+
+
+def churn_threshold() -> int:
+    return max(1, int(_env_float("PYRUHVRO_TPU_RECOMPILE_STORM", 8)))
+
+
+def sync_mode() -> bool:
+    """Should a launch be ``block_until_ready``-bounded right now?
+
+    ``PYRUHVRO_TPU_DEVICE_SYNC=1`` forces bounded launches, ``=0`` keeps
+    the pre-PR-5 async dispatch (d2h carries the wait). Unset = auto:
+    bounded, except with telemetry off (the off path must stay at bare
+    dispatch cost) or behind a probed-remote interconnect (the extra
+    sync would cost a full tunnel RTT per call)."""
+    v = os.environ.get("PYRUHVRO_TPU_DEVICE_SYNC", "").strip().lower()
+    if v in ("1", "on", "true"):
+        return True
+    if v in ("0", "off", "false"):
+        return False
+    if not telemetry.enabled():
+        return False
+    try:
+        from ..ops.codec import _rtt_result  # memo only; never probes
+
+        if _rtt_result and _rtt_result[0] > 0.010:
+            return False
+    except Exception:
+        pass
+    return True
+
+
+# ---------------------------------------------------------------------------
+# per-executable accounting + churn guard
+# ---------------------------------------------------------------------------
+
+
+def _entry(key: Tuple[str, str, str]) -> Dict[str, Any]:
+    """Get-or-create a registry row; callers hold ``_lock``."""
+    e = _registry.get(key)
+    if e is None:
+        e = _registry[key] = {
+            "fingerprint": key[0],
+            "kind": key[1],
+            "bucket": key[2],
+            "compiles": 0,
+            "hits": 0,
+            "launches": 0,
+            "compile_s": 0.0,
+            "launch_s": 0.0,
+        }
+    return e
+
+
+def note_compile(fingerprint: str, kind: str, bucket: str, seconds: float,
+                 cost: Optional[Dict[str, float]] = None) -> None:
+    """Record one compile in the registry and feed the churn guard.
+
+    The guard counts compiles per schema fingerprint inside a sliding
+    window; at >= PYRUHVRO_TPU_RECOMPILE_STORM it emits
+    ``device.recompile_storm`` and auto-dumps the flight recorder (the
+    same ``PYRUHVRO_TPU_FLIGHT_DIR`` contract as quarantine storms),
+    then clears the window so one storm fires once."""
+    storm = False
+    now = time.monotonic()
+    with _lock:
+        e = _entry((fingerprint, kind, bucket))
+        e["compiles"] += 1
+        e["compile_s"] = round(e["compile_s"] + seconds, 9)
+        if cost:
+            e["cost"] = cost
+        log = _compile_log.setdefault(fingerprint, deque())
+        log.append(now)
+        window = churn_window_s()
+        while log and now - log[0] > window:
+            log.popleft()
+        if len(log) >= churn_threshold():
+            storm = True
+            log.clear()
+    if storm:
+        metrics.inc("device.recompile_storm")
+        telemetry.annotate(recompile_storm=True)
+        telemetry._flight_autodump("recompile_storm")
+
+
+def _note_launch(fingerprint: str, kind: str, bucket: str,
+                 seconds: float) -> None:
+    with _lock:
+        e = _entry((fingerprint, kind, bucket))
+        e["launches"] += 1
+        e["launch_s"] = round(e["launch_s"] + seconds, 9)
+
+
+def _note_hit(fingerprint: str, kind: str, bucket: str) -> None:
+    with _lock:
+        _entry((fingerprint, kind, bucket))["hits"] += 1
+
+
+def note_memory(jax) -> None:
+    """Per-device memory watermarks where the backend exposes them
+    (``Device.memory_stats()`` — TPU/GPU); graceful no-op on CPU and on
+    any backend without the API. Watermarks land in the device snapshot
+    (``telemetry.snapshot()["device"]["memory"]``)."""
+    try:
+        devices = jax.local_devices()
+    except Exception:
+        return
+    for d in devices:
+        try:
+            ms = d.memory_stats()
+        except Exception:
+            ms = None
+        if not ms:
+            continue
+        in_use = int(ms.get("bytes_in_use", 0) or 0)
+        peak = int(ms.get("peak_bytes_in_use", 0) or in_use)
+        with _lock:
+            rec = _memory.setdefault(
+                f"{d.platform}:{d.id}", {"platform": d.platform}
+            )
+            rec["bytes_in_use"] = in_use
+            rec["peak_bytes_in_use"] = max(
+                peak, rec.get("peak_bytes_in_use", 0)
+            )
+            limit = ms.get("bytes_limit")
+            if limit:
+                rec["bytes_limit"] = int(limit)
+
+
+# ---------------------------------------------------------------------------
+# the instrumented jit wrapper
+# ---------------------------------------------------------------------------
+
+
+class InstrumentedJit:
+    """A jitted callable with the compile/launch split made observable.
+
+    Wraps an ALREADY-jitted function (the caller owns transform order —
+    ``jax.jit(fn)``, ``jax.jit(shard_map(...))``). The first call per
+    instance is the cache miss: it AOT-compiles via
+    ``lower(*args).compile()`` (timed as ``device.compile_s``, XLA
+    ``cost_analysis()`` recorded) and keeps the executable, so every
+    later call is a pure launch (``device.launch_s``,
+    ``block_until_ready``-bounded per :func:`sync_mode`). Where the AOT
+    path is unavailable the first call's full wall time is the compile
+    figure (``mode="first_call"``).
+
+    ``family`` keeps the legacy per-direction counters flowing
+    (``decode.compiles`` / ``decode.launches`` / ``encode.*``) so
+    pre-PR-5 dashboards and tests stay valid.
+    """
+
+    __slots__ = ("_jax", "_jit", "_exe", "_aot", "kind", "bucket",
+                 "fingerprint", "family", "_ilock")
+
+    def __init__(self, jax, jitted, *, kind: str, bucket: str,
+                 fingerprint: Optional[str] = None,
+                 family: Optional[str] = None):
+        self._jax = jax
+        self._jit = jitted
+        self._exe = None   # compiled executable (or the jit fn itself)
+        self._aot = False  # _exe is an AOT Compiled (retriable on arg
+        #                    mismatch by falling back to the jit fn)
+        self.kind = kind
+        self.bucket = str(bucket)
+        self.fingerprint = fingerprint or "?"
+        self.family = family
+        self._ilock = threading.Lock()
+
+    # -- the observable call ------------------------------------------------
+
+    def __call__(self, *args):
+        if self._exe is None:
+            with self._ilock:
+                if self._exe is None:
+                    return self._compile_and_run(args)
+        metrics.inc("device.jit_cache.hits")
+        _note_hit(self.fingerprint, self.kind, self.bucket)
+        return self._launch(args, count_family_launch=True)
+
+    def _compile_and_run(self, args):
+        """The cache-miss path: explicit compile, then one launch."""
+        metrics.inc("device.jit_cache.misses")
+        if self.family:
+            metrics.inc(self.family + ".compiles")
+        t0 = time.perf_counter()
+        exe = None
+        try:
+            exe = self._jit.lower(*args).compile()
+        except Exception:
+            exe = None
+        if exe is None:
+            # no AOT split on this callable/backend: the first call's
+            # wall time (trace + compile + run) IS the compile figure
+            out = self._jit(*args)
+            out = self._block(out)
+            dt = time.perf_counter() - t0
+            telemetry.observe("device.compile_s", dt, kind=self.kind,
+                              bucket=self.bucket, mode="first_call")
+            note_compile(self.fingerprint, self.kind, self.bucket, dt)
+            self._exe = self._jit
+            return out
+        dt = time.perf_counter() - t0
+        telemetry.observe("device.compile_s", dt, kind=self.kind,
+                          bucket=self.bucket)
+        note_compile(self.fingerprint, self.kind, self.bucket, dt,
+                     cost=self._cost(exe))
+        self._exe = exe
+        self._aot = True
+        return self._launch(args)
+
+    def _launch(self, args, count_family_launch: bool = False):
+        t0 = time.perf_counter()
+        try:
+            out = self._exe(*args)
+        except (TypeError, ValueError):
+            # ONLY the argument-signature/placement complaints an AOT
+            # Compiled raises where plain jit would accept (e.g.
+            # uncommitted host arrays on some backends) — genuine device
+            # runtime failures (XlaRuntimeError: OOM, launch errors)
+            # propagate untouched above. Degrade this entry to the jit
+            # fn rather than fail the call; the jit call below re-traces
+            # and RE-COMPILES, so it must be accounted as a compile
+            # (misses == actual compiles is the contract) — not as an
+            # inflated launch.
+            if not self._aot:
+                raise
+            self._exe = self._jit
+            self._aot = False
+            t1 = time.perf_counter()
+            out = self._block(self._exe(*args))
+            dt = time.perf_counter() - t1
+            metrics.inc("device.jit_cache.misses")
+            if self.family:
+                metrics.inc(self.family + ".compiles")
+            telemetry.observe("device.compile_s", dt, kind=self.kind,
+                              bucket=self.bucket, mode="aot_degrade")
+            note_compile(self.fingerprint, self.kind, self.bucket, dt)
+            return out
+        out = self._block(out)
+        dt = time.perf_counter() - t0
+        if count_family_launch and self.family:
+            metrics.inc(self.family + ".launches")
+        telemetry.observe("device.launch_s", dt, kind=self.kind,
+                          bucket=self.bucket)
+        _note_launch(self.fingerprint, self.kind, self.bucket, dt)
+        return out
+
+    def _block(self, out):
+        if not sync_mode():
+            return out
+        try:
+            return self._jax.block_until_ready(out)
+        except Exception:
+            return out
+
+    def _cost(self, exe) -> Optional[Dict[str, float]]:
+        """XLA cost_analysis flops / bytes for a compiled executable
+        (shape varies across JAX versions; all failures are silent —
+        cost numbers are evidence, never load-bearing)."""
+        try:
+            ca = exe.cost_analysis()
+        except Exception:
+            return None
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0] if ca else None
+        if not isinstance(ca, dict):
+            return None
+        try:
+            flops = float(ca.get("flops", 0.0) or 0.0)
+            byts = float(ca.get("bytes accessed", 0.0) or 0.0)
+        except (TypeError, ValueError):
+            return None
+        if flops:
+            metrics.inc("device.cost.flops", flops)
+        if byts:
+            metrics.inc("device.cost.bytes_accessed", byts)
+        if not flops and not byts:
+            return None
+        return {"flops": flops, "bytes_accessed": byts}
+
+
+# ---------------------------------------------------------------------------
+# export / reset
+# ---------------------------------------------------------------------------
+
+
+def snapshot() -> Dict[str, Any]:
+    """The device-tier detail section for ``telemetry.snapshot()``:
+    ``jit_cache`` rows keyed ``fingerprint|kind|bucket`` and per-device
+    ``memory`` watermarks. Empty dict when the device tier never ran —
+    snapshots stay byte-compatible with pre-device-telemetry consumers."""
+    with _lock:
+        out: Dict[str, Any] = {}
+        if _registry:
+            out["jit_cache"] = {
+                "|".join(k): dict(v) for k, v in sorted(_registry.items())
+            }
+        if _memory:
+            out["memory"] = {k: dict(v) for k, v in sorted(_memory.items())}
+    return out
+
+
+def reset() -> None:
+    """Clear the registry, memory watermarks and churn windows (test
+    isolation; called from ``telemetry.reset()``)."""
+    with _lock:
+        _registry.clear()
+        _memory.clear()
+        _compile_log.clear()

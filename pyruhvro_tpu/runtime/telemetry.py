@@ -17,12 +17,16 @@ only, cheap enough to stay always-on. ``set_enabled(False)`` (or
 ``PYRUHVRO_TPU_NO_TELEMETRY=1``) drops spans + histograms back to the
 bare counters — ``bench.py`` uses the toggle to measure the overhead.
 
-Three exporters:
+Four exporters:
 
 * :func:`snapshot` — structured dict: counters + per-``component.event``
   fixed-bucket latency histograms (p50/p95/p99) + the most recent root
-  span trees.
+  span trees (+ a ``device`` jit-cache/memory section when the device
+  tier ran — :mod:`.device_obs`).
 * :func:`prometheus` — the same snapshot in Prometheus text format.
+* :func:`perfetto_trace` — the span trees as Chrome/Perfetto
+  ``trace_event`` JSON (``python -m pyruhvro_tpu.telemetry perfetto``),
+  one timeline across all three tiers.
 * ``PYRUHVRO_TPU_TRACE=/path/or/stderr`` — opt-in JSON-lines stream, one
   line per finished root span.
 
@@ -67,6 +71,7 @@ __all__ = [
     "set_route",
     "snapshot",
     "prometheus",
+    "perfetto_trace",
     "reset",
     "set_enabled",
     "enabled",
@@ -595,6 +600,9 @@ def reset() -> None:
         _flight.clear()
         _roots_seen = 0
         _flight_last_auto = 0.0  # re-arm the auto-dump rate limiter
+    from . import device_obs
+
+    device_obs.reset()
     with _trace_lock:
         if _trace_memo is not None:
             fh = _trace_memo[1]
@@ -615,19 +623,29 @@ def reset() -> None:
 def snapshot() -> Dict[str, Any]:
     """Structured export: flat counters + histogram summaries + the most
     recent root span trees (oldest→newest; ``spans_dropped`` counts roots
-    aged out of the ring)."""
+    aged out of the ring). When the device tier ran, a ``device`` section
+    carries the jit-cache registry (per (schema fingerprint, shape
+    bucket) compile/launch/cost detail) and per-device memory watermarks
+    (:mod:`.device_obs`); it is omitted entirely otherwise so snapshots
+    stay shape-compatible with pre-device-telemetry consumers."""
     with _lock:
         hists = {k: h.summary() for k, h in sorted(_hists.items())}
         spans = [s.to_dict() for s in _spans]
         dropped = _roots_seen - len(_spans)
         flight_n = len(_flight)
-    return {
+    out = {
         "counters": metrics.snapshot(),
         "histograms": hists,
         "spans": spans,
         "spans_dropped": dropped,
         "flight_records": flight_n,
     }
+    from . import device_obs
+
+    dev = device_obs.snapshot()
+    if dev:
+        out["device"] = dev
+    return out
 
 
 def _prom_name(key: str) -> str:
@@ -668,6 +686,102 @@ def prometheus(snap: Optional[Dict[str, Any]] = None) -> str:
         lines.append(f"{name}_sum {float(h['sum'])!r}")
         lines.append(f"{name}_count {h['count']}")
     return "\n".join(lines) + ("\n" if lines else "")
+
+
+# -- Chrome/Perfetto trace_event exporter -----------------------------------
+#
+# One timeline for all three tiers: the snapshot's span trees — host
+# phases, the pool's re-parented thread/process chunk spans (PR 3) and
+# the device children (pack → h2d → compile/launch → d2h, retry rungs)
+# — rendered as Chrome trace-event JSON ("X" complete events, ts/dur in
+# microseconds), loadable in ui.perfetto.dev or chrome://tracing.
+#
+# Lane model: each root span tree renders into its process row (spans
+# re-parented from pool workers carry their worker's ``pid`` attr and
+# get their own process row); within a process, siblings that overlap in
+# time — concurrent thread-pool chunks — are spread across ``tid`` lanes
+# so the flame view nests exactly like the span tree instead of
+# collapsing parallel work onto one stack.
+
+
+def perfetto_trace(snap: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
+    """Render a snapshot's span trees as a Chrome trace-event document
+    (default: live state). Returns the JSON-serializable dict; the CLI
+    (``python -m pyruhvro_tpu.telemetry perfetto``) writes it out."""
+    if snap is None:
+        snap = snapshot()
+    main_pid = int(snap.get("pid") or os.getpid())
+    events: List[Dict[str, Any]] = []
+    seen_pids: Dict[int, str] = {}
+    next_tid: Dict[int, int] = {}
+    worker_tids: Dict[int, List[tuple]] = {}  # pid -> [(tid, label)]
+
+    def alloc_tid(pid: int) -> int:
+        t = next_tid.get(pid, 2)
+        next_tid[pid] = t + 1
+        return t
+
+    def emit(span: Dict[str, Any], pid: int, tid: int) -> None:
+        attrs = dict(span.get("attrs") or {})
+        span_pid = attrs.get("pid")
+        if isinstance(span_pid, (int, float)) and int(span_pid) != pid:
+            # a re-parented process-pool worker subtree: its own row
+            pid = int(span_pid)
+            tid = 1
+            seen_pids.setdefault(pid, f"pyruhvro_tpu worker {pid}")
+        ts = float(span.get("ts") or 0.0) * 1e6
+        dur = max(float(span.get("dur_s") or 0.0), 0.0) * 1e6
+        events.append({
+            "name": str(span.get("name", "?")),
+            "cat": str(span.get("name", "?")).split(".")[0],
+            "ph": "X",
+            "ts": round(ts, 3),
+            "dur": round(dur, 3),
+            "pid": pid,
+            "tid": tid,
+            "args": {k: v for k, v in attrs.items()
+                     if isinstance(v, (str, int, float, bool))},
+        })
+        children = sorted(
+            span.get("children") or [],
+            key=lambda c: float(c.get("ts") or 0.0),
+        )
+        # lane 0 = the parent's own tid; siblings overlapping the last
+        # span placed in every existing lane open a new tid lane
+        lane_end = [float("-inf")]
+        lane_tid = {0: tid}
+        for c in children:
+            cts = float(c.get("ts") or 0.0) * 1e6
+            cdur = max(float(c.get("dur_s") or 0.0), 0.0) * 1e6
+            lane = None
+            for i, end in enumerate(lane_end):
+                if cts >= end - 1.0:  # 1 µs slack for rounding
+                    lane = i
+                    break
+            if lane is None:
+                lane = len(lane_end)
+                lane_end.append(float("-inf"))
+            lane_end[lane] = cts + cdur
+            if lane not in lane_tid:
+                lane_tid[lane] = alloc_tid(pid)
+                worker_tids.setdefault(pid, []).append(
+                    (lane_tid[lane], f"pool lane {lane}")
+                )
+            emit(c, pid, lane_tid[lane])
+
+    seen_pids[main_pid] = "pyruhvro_tpu"
+    for root in snap.get("spans") or []:
+        emit(root, main_pid, 1)
+    meta: List[Dict[str, Any]] = []
+    for pid, name in sorted(seen_pids.items()):
+        meta.append({"name": "process_name", "ph": "M", "pid": pid,
+                     "tid": 0, "args": {"name": name}})
+        meta.append({"name": "thread_name", "ph": "M", "pid": pid,
+                     "tid": 1, "args": {"name": "calls"}})
+        for tid, label in worker_tids.get(pid, []):
+            meta.append({"name": "thread_name", "ph": "M", "pid": pid,
+                         "tid": tid, "args": {"name": label}})
+    return {"traceEvents": meta + events, "displayTimeUnit": "ms"}
 
 
 # -- JSON-lines trace stream (opt-in) ---------------------------------------
@@ -789,6 +903,80 @@ def _prof_tables(counters: Dict[str, float]) -> List[str]:
     return out
 
 
+def _fmt_bytes(v: float) -> str:
+    if v >= 1e9:
+        return f"{v / 1e9:.2f} GB"
+    if v >= 1e6:
+        return f"{v / 1e6:.2f} MB"
+    if v >= 1e3:
+        return f"{v / 1e3:.1f} kB"
+    return f"{v:.0f} B"
+
+
+def _device_section(counters: Dict[str, float],
+                    device: Dict[str, Any]) -> List[str]:
+    """The device-tier breakdown (ISSUE 5): compile-vs-launch split,
+    jit-cache hit ratio, transfer bytes, retry/storm counts, per-
+    executable registry rows and memory watermarks. Returns [] when the
+    snapshot predates (or never exercised) the device tier, so legacy
+    snapshots render untouched."""
+    keys = {k: v for k, v in counters.items() if k.startswith("device.")}
+    if not keys and not device:
+        return []
+    out = ["== device tier =="]
+    comp = keys.get("device.compile_s", 0.0)
+    launch = keys.get("device.launch_s", 0.0)
+    pipe = keys.get("device.pipeline_s", 0.0)
+    line = (f"compile {comp * 1e3:.3f} ms / launch {launch * 1e3:.3f} ms")
+    if pipe:
+        line += (f" (pipeline {pipe * 1e3:.3f} ms, "
+                 f"{(comp + launch) / pipe * 100:.1f}% compile+launch)")
+    out.append(line)
+    hits = keys.get("device.jit_cache.hits", 0.0)
+    misses = keys.get("device.jit_cache.misses", 0.0)
+    if hits or misses:
+        total = hits + misses
+        out.append(f"jit cache: {misses:.0f} miss(es) / {hits:.0f} hit(s)"
+                   f" = {hits / total * 100:.1f}% hit ratio")
+    h2d = keys.get("device.h2d_bytes", 0.0)
+    d2h = keys.get("device.d2h_bytes", 0.0)
+    if h2d or d2h:
+        out.append(f"transfers: h2d {_fmt_bytes(h2d)} / "
+                   f"d2h {_fmt_bytes(d2h)}")
+    retries = keys.get("device.retries", 0.0)
+    storms = keys.get("device.recompile_storm", 0.0)
+    if retries or storms:
+        out.append(f"capacity retries: {retries:.0f}; "
+                   f"recompile storms: {storms:.0f}")
+    flops = keys.get("device.cost.flops", 0.0)
+    ba = keys.get("device.cost.bytes_accessed", 0.0)
+    if flops or ba:
+        out.append(f"xla cost model: {flops:,.0f} flops, "
+                   f"{_fmt_bytes(ba)} accessed (sum over compiles)")
+    cache = (device or {}).get("jit_cache") or {}
+    if cache:
+        out.append("executables (fingerprint|kind|bucket):")
+        rows = sorted(cache.items(),
+                      key=lambda kv: -(kv[1].get("compile_s") or 0.0))
+        for key, e in rows[:12]:
+            out.append(
+                f"  {key}: {e.get('compiles', 0)} compile(s) "
+                f"{(e.get('compile_s') or 0) * 1e3:.1f} ms, "
+                f"{e.get('launches', 0)} launch(es) "
+                f"{(e.get('launch_s') or 0) * 1e3:.1f} ms, "
+                f"{e.get('hits', 0)} hit(s)"
+            )
+        if len(rows) > 12:
+            out.append(f"  ... {len(rows) - 12} more")
+    mem = (device or {}).get("memory") or {}
+    for dev_id, m in sorted(mem.items()):
+        out.append(
+            f"memory[{dev_id}]: in use {_fmt_bytes(m.get('bytes_in_use', 0))}"
+            f", peak {_fmt_bytes(m.get('peak_bytes_in_use', 0))}"
+        )
+    return out
+
+
 def _render_span(s: Dict[str, Any], indent: int, out: List[str]) -> None:
     attrs = " ".join(f"{k}={v}" for k, v in s.get("attrs", {}).items())
     dur = s.get("dur_s")
@@ -844,6 +1032,10 @@ def render_report(data: Dict[str, Any]) -> str:
         if prof:
             out += ["", "== native profiler (per-opcode self time) =="]
             out.extend(prof)
+        dev = _device_section(counters, data.get("device") or {})
+        if dev:
+            out += [""]
+            out.extend(dev)
         workers = {k: v for k, v in counters.items()
                    if k.startswith(("pool.worker", "pool.proc"))}
         if workers.get("pool.worker_rows") or workers.get("pool.worker_merges"):
@@ -857,6 +1049,7 @@ def render_report(data: Dict[str, Any]) -> str:
         other = {k: v for k, v in counters.items()
                  if not k.endswith("_s") and not k.startswith("route.")
                  and not k.startswith(_PROF_PREFIXES)
+                 and not k.startswith("device.")  # rendered above
                  and k not in workers}
         if other:
             out += ["", "== counters =="]
@@ -873,8 +1066,9 @@ def render_report(data: Dict[str, Any]) -> str:
 
 def main(argv: Optional[List[str]] = None) -> int:
     """CLI: ``report <file>`` (phase table) / ``prom <file>`` (text
-    exposition). ``<file>`` is a saved :func:`snapshot` JSON or, for
-    ``report``, a ``BENCH_DETAILS.json``."""
+    exposition) / ``perfetto <file> [-o out.json]`` (Chrome/Perfetto
+    trace-event timeline). ``<file>`` is a saved :func:`snapshot` JSON
+    or, for ``report``, a ``BENCH_DETAILS.json``."""
     import argparse
 
     ap = argparse.ArgumentParser(
@@ -889,6 +1083,12 @@ def main(argv: Optional[List[str]] = None) -> int:
     p_prom = sub.add_parser(
         "prom", help="Prometheus text format from a snapshot JSON")
     p_prom.add_argument("path")
+    p_perf = sub.add_parser(
+        "perfetto", help="Chrome trace-event JSON (load in "
+                         "ui.perfetto.dev) from a snapshot JSON")
+    p_perf.add_argument("path")
+    p_perf.add_argument("-o", "--out",
+                        help="write the trace here instead of stdout")
     args = ap.parse_args(argv)
 
     def _usage_error(msg: str) -> int:
@@ -918,6 +1118,21 @@ def main(argv: Optional[List[str]] = None) -> int:
                 f"{args.path} has none of the expected keys "
                 "('results' / 'counters' / 'histograms')")
         sys.stdout.write(render_report(data))
+    elif args.cmd == "perfetto":
+        if not ({"spans", "counters", "histograms"} & set(data)):
+            return _usage_error(
+                "not a telemetry snapshot (expected 'spans'/'counters'/"
+                "'histograms' keys)")
+        trace = perfetto_trace(data)
+        if args.out:
+            with open(args.out, "w", encoding="utf-8") as f:
+                json.dump(trace, f, indent=1, default=str)
+            n = sum(1 for e in trace["traceEvents"] if e["ph"] == "X")
+            print(f"wrote {n} span event(s) -> {args.out} "
+                  "(load in ui.perfetto.dev)", file=sys.stderr)
+        else:
+            json.dump(trace, sys.stdout, indent=1, default=str)
+            sys.stdout.write("\n")
     else:
         if "counters" not in data and "histograms" not in data:
             return _usage_error(

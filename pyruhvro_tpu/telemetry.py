@@ -5,9 +5,11 @@ Usage::
     python -m pyruhvro_tpu.telemetry report BENCH_DETAILS.json
     python -m pyruhvro_tpu.telemetry report snapshot.json
     python -m pyruhvro_tpu.telemetry prom snapshot.json
+    python -m pyruhvro_tpu.telemetry perfetto snapshot.json -o trace.json
 
 (``scripts/metrics_report.py`` is the tier-1-safe wrapper over the same
-entry point.)
+entry point; ``perfetto`` output loads in ui.perfetto.dev /
+chrome://tracing.)
 """
 
 import sys

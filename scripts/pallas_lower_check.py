@@ -9,20 +9,35 @@ execute the kernel — the Mosaic→machine-code stage still happens on a
 chip at XLA compile time — so this is a compilability guard, not a
 perf check (``scripts/ab_pallas.py`` covers the live chip).
 
+Beyond pass/fail, every covered (schema, BW, cap) shape's lowering
+stats — wall seconds to lower, serialized MLIR byte size, and the
+kernel-eligibility verdict — persist to ``PALLAS_LOWER_STATS.json``
+(ISSUE 5), so lowering-time and module-size regressions are diffable
+across rounds instead of vanishing into CI logs.
+
 Run on CPU: ``PYTHONPATH= JAX_PLATFORMS=cpu python scripts/pallas_lower_check.py``
 Exit 0 = every covered shape lowers; 1 = a lowering failure (printed).
 """
 
 from __future__ import annotations
 
+import json
+import os
 import sys
+import time
 
 sys.path.insert(0, ".")
 
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+DEFAULT_STATS = os.path.join(REPO, "PALLAS_LOWER_STATS.json")
 
-def main() -> int:
+
+def main(out_path: str = DEFAULT_STATS) -> int:
     import jax
     import numpy as np
+    # jax.export is a lazily-importable submodule on some JAX versions
+    # (plain `jax.export` raises AttributeError there)
+    from jax import export as jax_export
 
     from pyruhvro_tpu.ops import UnsupportedOnDevice
     from pyruhvro_tpu.ops.pallas_decode import PallasKernelDecoder
@@ -32,11 +47,14 @@ def main() -> int:
     shapes = dict(CRITERION_SHAPES)
     shapes["kafka"] = KAFKA_SCHEMA_JSON
     failures = 0
+    stats = []
     for name, schema in sorted(shapes.items()):
         try:
             dec = PallasKernelDecoder(parse_schema(schema), interpret=False)
         except UnsupportedOnDevice as e:
             print(f"{name:22s} SKIP (outside kernel subset): {e}")
+            stats.append({"schema": name, "kernel_eligible": False,
+                          "reason": str(e)})
             continue
         has_items = dec.n_regions > 1
         for BW, cap in [(16, 8), (64, 8)] + ([(16, 128)] if has_items
@@ -44,9 +62,13 @@ def main() -> int:
             caps = tuple(0 if r == 0 else cap
                          for r in range(dec.n_regions))
             tile_r = dec._tile_rows(BW, caps)
+            row = {"schema": name, "BW": BW, "cap": cap,
+                   "tile_r": tile_r, "kernel_eligible": True}
             if tile_r < 128:
                 print(f"{name:22s} BW={BW:3d} cap={cap} SKIP "
                       f"(tile cannot fit VMEM — runtime falls back)")
+                row.update(kernel_eligible=False, reason="vmem_budget")
+                stats.append(row)
                 continue
             grid_r = 1
             fn = dec._build(grid_r, tile_r, BW, caps)
@@ -57,18 +79,41 @@ def main() -> int:
                 np.zeros(R, np.int32),
             )
             try:
-                exp = jax.export.export(fn, platforms=["tpu"])(*args)
+                t0 = time.perf_counter()
+                exp = jax_export.export(fn, platforms=["tpu"])(*args)
+                row["lower_s"] = round(time.perf_counter() - t0, 4)
+                row["mlir_bytes"] = len(exp.mlir_module_serialized)
                 print(f"{name:22s} BW={BW:3d} cap={cap:3d} "
                       f"tile_r={tile_r:4d} "
-                      f"lowered ({len(exp.mlir_module_serialized)} B mlir)")
+                      f"lowered ({row['mlir_bytes']} B mlir, "
+                      f"{row['lower_s'] * 1e3:.0f} ms)")
             except Exception as e:  # noqa: BLE001 — the guard's output
                 print(f"{name:22s} BW={BW:3d} cap={cap:3d} "
                       f"LOWERING FAILED: "
                       f"{type(e).__name__}: {str(e)[:300]}")
+                row.update(kernel_eligible=False, lowering_failed=True,
+                           error=f"{type(e).__name__}: {str(e)[:300]}")
                 failures += 1
+            stats.append(row)
+    doc = {
+        "note": "per-shape Pallas→Mosaic lowering stats "
+                "(scripts/pallas_lower_check.py); lower_s is the "
+                "jax.export wall time on the producing host, "
+                "mlir_bytes the serialized module size.",
+        "jax": jax.__version__,
+        "failures": failures,
+        "stats": stats,
+    }
+    try:
+        with open(out_path, "w", encoding="utf-8") as f:
+            json.dump(doc, f, indent=1)
+            f.write("\n")
+        print(f"stats -> {out_path}")
+    except OSError as e:
+        print(f"could not write {out_path}: {e!r}")
     print(f"pallas lowering check: {failures} failures")
     return 1 if failures else 0
 
 
 if __name__ == "__main__":
-    sys.exit(main())
+    sys.exit(main(sys.argv[1] if len(sys.argv) > 1 else DEFAULT_STATS))

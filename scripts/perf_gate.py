@@ -192,11 +192,24 @@ def append_trajectory(path: str, entry: dict) -> None:
 
 
 def save_snapshot(path: str) -> None:
+    """The run's full telemetry snapshot — including the ``device``
+    jit-cache/memory section when the device tier ran — as the gate's
+    evidence artifact (CI exports it as a Perfetto trace too)."""
     from pyruhvro_tpu.runtime import telemetry
 
     with open(path, "w", encoding="utf-8") as f:
         json.dump(telemetry.snapshot(), f, indent=1, default=str)
     _log(f"[perf-gate] telemetry snapshot -> {path}")
+
+
+def _device_counters() -> Dict[str, float]:
+    """The flat ``device.*`` counters of the current process (jit cache,
+    compile/launch seconds, transfer bytes, retries) — the section the
+    baseline/bench snapshots embed."""
+    from pyruhvro_tpu.runtime import metrics
+
+    return {k: round(v, 6) for k, v in sorted(metrics.snapshot().items())
+            if k.startswith("device.")}
 
 
 def main(argv: Optional[list] = None) -> int:
@@ -272,6 +285,12 @@ def main(argv: Optional[list] = None) -> int:
             "calib_s": calib,
             "machine": {"cpus": os.cpu_count()},
             "cases": fresh,
+            # device-tier telemetry of the measuring run (ISSUE 5):
+            # compile/launch split, jit-cache and transfer counters —
+            # empty on host-only gate runs, populated when a device-path
+            # case is ever added, so baselines carry their own routing
+            # evidence either way
+            "device": _device_counters(),
         }
         with open(args.baseline, "w", encoding="utf-8") as f:
             json.dump(doc, f, indent=1, sort_keys=True)
