@@ -200,6 +200,13 @@ def _run_case(op, schema, datums, backend, chunks, reps, details,
             )
 
     telemetry.reset()  # clears spans + histograms + the flat counters
+    # the reset also clears the in-memory routing model: reload the warm
+    # profile so an autotuned bench run routes from persisted knowledge
+    # instead of re-learning per case
+    from pyruhvro_tpu.runtime import costmodel
+
+    if costmodel.autotune_enabled():
+        costmodel.load_profile()
     try:
         times = _time_reps(run, reps)
     except Exception as e:
@@ -267,9 +274,46 @@ def _run_case(op, schema, datums, backend, chunks, reps, details,
         _log(f"[bench] native profiler: vm.op.* self time "
              f"{vm_op_s * 1e3:.3f} ms = "
              f"{native_prof['coverage_of_vm'] * 100:.1f}% of host.vm_s")
+    # routing decision per case (ISSUE 6): WHY the number is what it is
+    # rides into BENCH_DETAILS.json — the arm that served the timed
+    # reps, the decision mode, and predicted vs observed cost, so a
+    # trajectory diff shows "the router moved this case to another arm"
+    # instead of a bare throughput delta
+    routing = None
+    ledger = (tsnap.get("routing") or {}).get("ledger") or []
+    if ledger:
+        by_arm = {}
+        for e in ledger:
+            by_arm[e.get("arm", "?")] = by_arm.get(e.get("arm", "?"), 0) + 1
+        last = ledger[-1]
+        routing = {
+            "arm": last.get("arm"),
+            "mode": last.get("mode"),
+            "reason": last.get("reason"),
+            "autotune": last.get("autotune"),
+            "predicted_s": last.get("predicted_s"),
+            "observed_s": last.get("observed_s"),
+            "arms_used": by_arm,
+        }
+        _log(f"[bench] {label or ''}{op}[{backend}] routing: "
+             f"arm={routing['arm']} mode={routing['mode']} "
+             f"pred={routing['predicted_s']} obs={routing['observed_s']}")
+    # chunk fan-out efficiency (ISSUE 6 satellite): mean over the
+    # case's fan-outs — 1.0 = chunks fully overlapped, 1/chunks =
+    # serialized, absent = no fan-out happened (slice mode)
+    pool_sec = None
+    eff_n = snap.get("pool.eff_fanouts", 0)
+    if eff_n:
+        pool_sec = {
+            "fanouts": int(eff_n),
+            "chunk_efficiency": round(
+                snap.get("pool.chunk_efficiency", 0.0) / eff_n, 4),
+        }
     details["results"].append({
         **({"native_prof": native_prof} if native_prof else {}),
         **({"device": device} if device else {}),
+        **({"routing": routing} if routing else {}),
+        **({"pool": pool_sec} if pool_sec else {}),
         "op": op, "backend": backend, "rows": rows, "chunks": chunks,
         "schema": label or "kafka", "seconds": dt, "records_per_s": rec_s,
         "vs_baseline": rec_s / base,

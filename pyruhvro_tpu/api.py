@@ -50,9 +50,9 @@ from .fallback.decoder import (
 )
 from .fallback.encoder import compile_encoder_plan, encode_record_batch
 from .fallback.io import MalformedAvro, max_datum_bytes, shift_malformed
-from .runtime import metrics, quarantine, telemetry
+from .runtime import metrics, quarantine, router, telemetry
 from .runtime.chunking import bounds_rows, chunk_bounds
-from .runtime.pool import map_chunks, map_chunks_proc, pool_mode
+from .runtime.pool import map_chunks, map_chunks_proc
 from .schema.cache import SchemaEntry, get_or_parse_schema
 
 __all__ = [
@@ -137,16 +137,22 @@ def _device_codec(entry: SchemaEntry, backend: str):
     return _device_codec_ex(entry, backend)[0]
 
 
-def _route(entry: SchemaEntry, backend: str, n_rows: int,
-           *, need_encode: bool = False):
-    """Resolve which tier serves this call → ``(tier, impl, reason)``.
+def _route_candidates(entry: SchemaEntry, backend: str, n_rows: int,
+                      *, need_encode: bool = False):
+    """Static-gate verdict PLUS the available-tier candidate map the
+    router chooses among → ``(tier, impl, reason, candidates)``.
 
-    tier: ``"device"`` (impl = DeviceCodec), ``"native"`` (impl =
-    NativeHostCodec) or ``"fallback"`` (impl = None, pure-Python path).
-    ``reason`` is the routing explainer recorded on the call span — for
-    host-side tiers it names why the device path was NOT taken."""
+    The static verdict is the pre-router behavior bit for bit (and the
+    router's cold-start policy). ``candidates`` maps every tier that
+    COULD serve this call to its impl: a device codec that the static
+    gate passes over (``device_min_rows`` / ``devices_cpu_only`` /
+    ``interconnect_remote``) stays a candidate arm — under
+    ``PYRUHVRO_TPU_AUTOTUNE=1`` the learned cost model, not the env
+    knob, decides whether it ever runs. A forced backend collapses the
+    candidate set to the forced tier's options."""
     codec = None
     reason = None
+    host_pref = None
     if backend == "host":
         reason = "backend_host"
     elif need_encode and not _device_encode_available():
@@ -161,17 +167,56 @@ def _route(entry: SchemaEntry, backend: str, n_rows: int,
     else:
         codec, reason = _device_codec_ex(entry, backend)
         if codec is not None and backend == "auto":
-            host_reason = _auto_prefers_host(entry, n_rows)
-            if host_reason:
-                codec, reason = None, host_reason
+            host_pref = _auto_prefers_host(entry, n_rows)
+    # a forced-device call never runs (or offers) a host tier: don't
+    # build and pin a native codec it can't use
+    native = None if backend == "tpu" else _native_host_codec(entry)
+    candidates = {}
     if codec is not None:
+        candidates["device"] = codec
+    if backend != "tpu":
+        if native is not None:
+            candidates["native"] = native
+        else:
+            candidates["fallback"] = None
+    if codec is not None and host_pref is None:
         return "device", codec, (
             "backend_tpu" if backend == "tpu" else "device_selected"
-        )
-    native = _native_host_codec(entry)
+        ), candidates
+    if host_pref is not None:
+        reason = host_pref
     if native is not None:
-        return "native", native, reason
-    return "fallback", None, reason
+        return "native", native, reason, candidates
+    return "fallback", None, reason, candidates
+
+
+def _route(entry: SchemaEntry, backend: str, n_rows: int,
+           *, need_encode: bool = False):
+    """Resolve which tier serves this call → ``(tier, impl, reason)``.
+
+    tier: ``"device"`` (impl = DeviceCodec), ``"native"`` (impl =
+    NativeHostCodec) or ``"fallback"`` (impl = None, pure-Python path).
+    ``reason`` is the routing explainer recorded on the call span — for
+    host-side tiers it names why the device path was NOT taken. This is
+    the STATIC verdict; API calls route through :func:`_decide`, which
+    may override it from the learned cost model when
+    ``PYRUHVRO_TPU_AUTOTUNE=1``."""
+    tier, impl, reason, _cands = _route_candidates(
+        entry, backend, n_rows, need_encode=need_encode)
+    return tier, impl, reason
+
+
+def _decide(entry: SchemaEntry, backend: str, n_rows: int, *, op: str,
+            chunks: int = 1, need_encode: bool = False):
+    """One routed decision: static gates feed the router as the
+    cold-start policy, the router predicts/acts (ledger +
+    autotune), and the verdict lands on the call span."""
+    tier, impl, reason, cands = _route_candidates(
+        entry, backend, n_rows, need_encode=need_encode)
+    dec = router.decide(entry, backend, n_rows, op=op, chunks=chunks,
+                        candidates=cands, static=(tier, impl, reason))
+    telemetry.set_route(dec.tier, dec.reason)
+    return dec
 
 
 def _native_host_codec(entry: SchemaEntry):
@@ -644,29 +689,41 @@ def deserialize_array(
     entry = get_or_parse_schema(schema)
     with telemetry.root_span("api.deserialize_array", rows=len(data),
                              backend=backend, schema=entry.fingerprint):
-        tier, impl, reason = _route(entry, backend, len(data))
-        telemetry.set_route(tier, reason)
-        if on_error == "raise":
-            _enforce_max_datum(data)
-            if tier != "fallback":
-                batch = impl.decode(data)
-            else:
-                with telemetry.phase("fallback.decode_s", rows=len(data)):
-                    batch = decode_to_record_batch(
-                        data, entry.ir, entry.arrow_schema,
-                        _host_reader(entry),
-                    )
-            return (batch, []) if return_errors else batch
-        with quarantine.collecting() as quar:
-            with telemetry.phase("decode.tolerant_s", rows=len(data),
-                                 tier=tier):
-                batch, entries = _tolerant_decode(
-                    tier, impl, entry, data, 0)
-            quar.extend(entries)
-            batch = _apply_null_policy(
-                batch, entries, 0, len(data), on_error, entry)
-            quarantine.publish(quar, on_error)
-        return (batch, quar) if return_errors else batch
+        dec = _decide(entry, backend, len(data), op="decode")
+        try:
+            out = _deserialize_one(dec, entry, data, on_error,
+                                   return_errors)
+        except Exception as e:
+            router.observe(dec, error=e)
+            raise
+        router.observe(dec)
+        return out
+
+
+def _deserialize_one(dec, entry, data, on_error, return_errors):
+    """The single-batch decode body, on the decided tier."""
+    tier, impl = dec.tier, dec.impl
+    if on_error == "raise":
+        _enforce_max_datum(data)
+        if tier != "fallback":
+            batch = impl.decode(data)
+        else:
+            with telemetry.phase("fallback.decode_s", rows=len(data)):
+                batch = decode_to_record_batch(
+                    data, entry.ir, entry.arrow_schema,
+                    _host_reader(entry),
+                )
+        return (batch, []) if return_errors else batch
+    with quarantine.collecting() as quar:
+        with telemetry.phase("decode.tolerant_s", rows=len(data),
+                             tier=tier):
+            batch, entries = _tolerant_decode(
+                tier, impl, entry, data, 0)
+        quar.extend(entries)
+        batch = _apply_null_policy(
+            batch, entries, 0, len(data), on_error, entry)
+        quarantine.publish(quar, on_error)
+    return (batch, quar) if return_errors else batch
 
 
 def deserialize_array_threaded(
@@ -694,89 +751,106 @@ def deserialize_array_threaded(
     with telemetry.root_span("api.deserialize_array_threaded",
                              rows=len(data), chunks=num_chunks,
                              backend=backend, schema=entry.fingerprint):
-        tier, impl, reason = _route(entry, backend, len(data))
-        telemetry.set_route(tier, reason)
-        if on_error == "raise":
-            _enforce_max_datum(data)
-            if (tier != "device" and len(bounds) > 1
-                    and pool_mode() == "process"):
-                out = _proc_map(
-                    _proc_decode_task,
-                    [(schema, list(data[a:b]), a, "raise")
-                     for a, b in bounds],
-                    rows=lambda p: len(p[1]),
-                )
-                if out is not None:
-                    return (out, []) if return_errors else out
-            if tier != "fallback":
-                out = impl.decode_threaded(data, num_chunks)
+        dec = _decide(entry, backend, len(data), op="decode",
+                      chunks=len(bounds))
+        try:
+            out = _deserialize_chunks(dec, entry, data, schema,
+                                      num_chunks, bounds, on_error,
+                                      return_errors)
+        except Exception as e:
+            router.observe(dec, error=e)
+            raise
+        router.observe(dec)
+        return out
+
+
+def _deserialize_chunks(dec, entry, data, schema, num_chunks, bounds,
+                        on_error, return_errors):
+    """The chunked decode body, on the decided (tier, pool) arm."""
+    tier, impl = dec.tier, dec.impl
+    use_proc = dec.pool == "process"  # router/env picked the spawn pool
+    if on_error == "raise":
+        _enforce_max_datum(data)
+        if use_proc:
+            out = _proc_map(
+                _proc_decode_task,
+                [(schema, list(data[a:b]), a, "raise")
+                 for a, b in bounds],
+                rows=lambda p: len(p[1]),
+            )
+            if out is not None:
                 return (out, []) if return_errors else out
-            ir, arrow = entry.ir, entry.arrow_schema
-            reader = _host_reader(entry)
-
-            def decode_chunk(ab):
-                with telemetry.phase("fallback.decode_s",
-                                     rows=ab[1] - ab[0]):
-                    return decode_to_record_batch(
-                        data[ab[0]:ab[1]], ir, arrow, reader,
-                        index_base=ab[0],
-                    )
-
-            out = map_chunks(decode_chunk, bounds, rows=bounds_rows)
+            dec.degraded = True  # thread path serves a process-arm call
+        if tier != "fallback":
+            out = impl.decode_threaded(data, num_chunks)
             return (out, []) if return_errors else out
-        # tolerant policies: per-chunk isolation so one poisoned chunk
-        # never forces another chunk off its fast path
-        with quarantine.collecting() as quar:
-            out = None
-            if (tier != "device" and len(bounds) > 1
-                    and pool_mode() == "process"):
-                # workers apply the policy on their own slice and ship
-                # quarantine entries back with the telemetry payload
-                # (merged into `quar` by telemetry.merge_worker)
-                out = _proc_map(
-                    _proc_decode_task,
-                    [(schema, list(data[a:b]), a, on_error)
-                     for a, b in bounds],
-                    rows=lambda p: len(p[1]),
-                )
-            if out is None:
-                # a failed pool fan-out may have merged partial worker
-                # results: the paths below redecode every chunk, so
-                # start the collector clean
-                quar.clear()
-                quarantine.reset_merged()
-                # optimistic fast path: a clean batch takes EXACTLY the
-                # "raise" execution shape (one fused/sharded launch on
-                # the device tier, the VM's per-chunk mode on native) —
-                # only a failure drops to per-chunk isolation below.
-                # With the MAX_DATUM_BYTES knob set, oversized datums
-                # must quarantine even though the tiers would decode
-                # them, so the screening per-chunk path serves instead.
-                if tier != "fallback" and not max_datum_bytes():
-                    try:
-                        out = impl.decode_threaded(data, num_chunks)
-                    except Exception:
-                        out = None
-            if out is None:
-                def tolerant_chunk(ab):
-                    a, b = ab
-                    with telemetry.phase("decode.tolerant_s",
-                                         rows=b - a, tier=tier):
-                        batch, entries = _tolerant_decode(
-                            tier, impl, entry, data[a:b], a)
-                    quar.extend(entries)
-                    return _apply_null_policy(
-                        batch, entries, a, b - a, on_error, entry)
+        ir, arrow = entry.ir, entry.arrow_schema
+        reader = _host_reader(entry)
 
-                if tier == "device":
-                    # the device decode is internally parallel (mesh /
-                    # VM shards); host-thread fan-out adds nothing
-                    out = [tolerant_chunk(ab) for ab in bounds]
-                else:
-                    out = map_chunks(tolerant_chunk, bounds,
-                                     rows=bounds_rows)
-            quarantine.publish(quar, on_error)
-        return (out, quar) if return_errors else out
+        def decode_chunk(ab):
+            with telemetry.phase("fallback.decode_s",
+                                 rows=ab[1] - ab[0]):
+                return decode_to_record_batch(
+                    data[ab[0]:ab[1]], ir, arrow, reader,
+                    index_base=ab[0],
+                )
+
+        out = map_chunks(decode_chunk, bounds, rows=bounds_rows)
+        return (out, []) if return_errors else out
+    # tolerant policies: per-chunk isolation so one poisoned chunk
+    # never forces another chunk off its fast path
+    with quarantine.collecting() as quar:
+        out = None
+        if use_proc:
+            # workers apply the policy on their own slice and ship
+            # quarantine entries back with the telemetry payload
+            # (merged into `quar` by telemetry.merge_worker)
+            out = _proc_map(
+                _proc_decode_task,
+                [(schema, list(data[a:b]), a, on_error)
+                 for a, b in bounds],
+                rows=lambda p: len(p[1]),
+            )
+            if out is None:
+                dec.degraded = True
+        if out is None:
+            # a failed pool fan-out may have merged partial worker
+            # results: the paths below redecode every chunk, so
+            # start the collector clean
+            quar.clear()
+            quarantine.reset_merged()
+            # optimistic fast path: a clean batch takes EXACTLY the
+            # "raise" execution shape (one fused/sharded launch on
+            # the device tier, the VM's per-chunk mode on native) —
+            # only a failure drops to per-chunk isolation below.
+            # With the MAX_DATUM_BYTES knob set, oversized datums
+            # must quarantine even though the tiers would decode
+            # them, so the screening per-chunk path serves instead.
+            if tier != "fallback" and not max_datum_bytes():
+                try:
+                    out = impl.decode_threaded(data, num_chunks)
+                except Exception:
+                    out = None
+        if out is None:
+            def tolerant_chunk(ab):
+                a, b = ab
+                with telemetry.phase("decode.tolerant_s",
+                                     rows=b - a, tier=tier):
+                    batch, entries = _tolerant_decode(
+                        tier, impl, entry, data[a:b], a)
+                quar.extend(entries)
+                return _apply_null_policy(
+                    batch, entries, a, b - a, on_error, entry)
+
+            if tier == "device":
+                # the device decode is internally parallel (mesh /
+                # VM shards); host-thread fan-out adds nothing
+                out = [tolerant_chunk(ab) for ab in bounds]
+            else:
+                out = map_chunks(tolerant_chunk, bounds,
+                                 rows=bounds_rows)
+        quarantine.publish(quar, on_error)
+    return (out, quar) if return_errors else out
 
 
 def deserialize_array_threaded_spawn(
@@ -820,71 +894,87 @@ def serialize_record_batch(
     with telemetry.root_span("api.serialize_record_batch",
                              rows=batch.num_rows, chunks=num_chunks,
                              backend=backend, schema=entry.fingerprint):
-        tier, impl, reason = _route(entry, backend, batch.num_rows,
-                                    need_encode=True)
-        telemetry.set_route(tier, reason)
-        if on_error == "raise":
-            if (tier != "device" and len(bounds) > 1
-                    and pool_mode() == "process"):
-                out = _proc_map(
-                    _proc_encode_task,
-                    [(schema, batch.slice(a, b - a), a, "raise")
-                     for a, b in bounds],
-                    rows=lambda p: p[1].num_rows,
-                )
-                if out is not None:
-                    return (out, []) if return_errors else out
-            if tier != "fallback":
-                out = impl.encode_threaded(batch, num_chunks)
-                return (out, []) if return_errors else out
-            ir = entry.ir
-            plan = entry.get_extra(
-                "host_encode_plan", lambda: compile_encoder_plan(ir)
+        dec = _decide(entry, backend, batch.num_rows, op="encode",
+                      chunks=len(bounds), need_encode=True)
+        try:
+            out = _serialize_chunks(dec, entry, batch, schema,
+                                    num_chunks, bounds, on_error,
+                                    return_errors)
+        except Exception as e:
+            router.observe(dec, error=e)
+            raise
+        router.observe(dec)
+        return out
+
+
+def _serialize_chunks(dec, entry, batch, schema, num_chunks, bounds,
+                      on_error, return_errors):
+    """The chunked encode body, on the decided (tier, pool) arm."""
+    tier, impl = dec.tier, dec.impl
+    use_proc = dec.pool == "process"  # router/env picked the spawn pool
+    if on_error == "raise":
+        if use_proc:
+            out = _proc_map(
+                _proc_encode_task,
+                [(schema, batch.slice(a, b - a), a, "raise")
+                 for a, b in bounds],
+                rows=lambda p: p[1].num_rows,
             )
-
-            def encode_chunk(ab):
-                with telemetry.phase("fallback.encode_s",
-                                     rows=ab[1] - ab[0]):
-                    datums = encode_record_batch(
-                        batch.slice(ab[0], ab[1] - ab[0]), ir, plan
-                    )
-                    return pa.array(datums, pa.binary())
-
-            out = map_chunks(encode_chunk, bounds, rows=bounds_rows)
+            if out is not None:
+                return (out, []) if return_errors else out
+            dec.degraded = True  # thread path serves a process-arm call
+        if tier != "fallback":
+            out = impl.encode_threaded(batch, num_chunks)
             return (out, []) if return_errors else out
-        with quarantine.collecting() as quar:
-            out = None
-            if (tier != "device" and len(bounds) > 1
-                    and pool_mode() == "process"):
-                out = _proc_map(
-                    _proc_encode_task,
-                    [(schema, batch.slice(a, b - a), a, on_error)
-                     for a, b in bounds],
-                    rows=lambda p: p[1].num_rows,
+        ir = entry.ir
+        plan = entry.get_extra(
+            "host_encode_plan", lambda: compile_encoder_plan(ir)
+        )
+
+        def encode_chunk(ab):
+            with telemetry.phase("fallback.encode_s",
+                                 rows=ab[1] - ab[0]):
+                datums = encode_record_batch(
+                    batch.slice(ab[0], ab[1] - ab[0]), ir, plan
                 )
-                if out is not None and quar:
-                    # per-input-chunk survivor arrays → the documented
-                    # shape: ONE array re-chunked over surviving rows
-                    # (identical to the thread path's return)
-                    whole = pa.concat_arrays(out)
-                    out = [
-                        whole.slice(a, b - a)
-                        for a, b in chunk_bounds(len(whole), num_chunks)
-                    ]
+                return pa.array(datums, pa.binary())
+
+        out = map_chunks(encode_chunk, bounds, rows=bounds_rows)
+        return (out, []) if return_errors else out
+    with quarantine.collecting() as quar:
+        out = None
+        if use_proc:
+            out = _proc_map(
+                _proc_encode_task,
+                [(schema, batch.slice(a, b - a), a, on_error)
+                 for a, b in bounds],
+                rows=lambda p: p[1].num_rows,
+            )
             if out is None:
-                quar.clear()
-                quarantine.reset_merged()
-                with telemetry.phase("encode.tolerant_s",
-                                     rows=batch.num_rows, tier=tier):
-                    arr, entries = _tolerant_encode(
-                        tier, impl, entry, batch, on_error)
-                quar.extend(entries)
+                dec.degraded = True
+            if out is not None and quar:
+                # per-input-chunk survivor arrays → the documented
+                # shape: ONE array re-chunked over surviving rows
+                # (identical to the thread path's return)
+                whole = pa.concat_arrays(out)
                 out = [
-                    arr.slice(a, b - a)
-                    for a, b in chunk_bounds(len(arr), num_chunks)
+                    whole.slice(a, b - a)
+                    for a, b in chunk_bounds(len(whole), num_chunks)
                 ]
-            quarantine.publish(quar, on_error, op="encode")
-        return (out, quar) if return_errors else out
+        if out is None:
+            quar.clear()
+            quarantine.reset_merged()
+            with telemetry.phase("encode.tolerant_s",
+                                 rows=batch.num_rows, tier=tier):
+                arr, entries = _tolerant_encode(
+                    tier, impl, entry, batch, on_error)
+            quar.extend(entries)
+            out = [
+                arr.slice(a, b - a)
+                for a, b in chunk_bounds(len(arr), num_chunks)
+            ]
+        quarantine.publish(quar, on_error, op="encode")
+    return (out, quar) if return_errors else out
 
 
 def serialize_record_batch_spawn(

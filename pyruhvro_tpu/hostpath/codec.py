@@ -204,15 +204,32 @@ class NativeHostCodec:
                         num_chunks: int) -> List[pa.RecordBatch]:
         """Chunked decode → one RecordBatch per chunk (reference chunk
         slicing, ``deserialize.rs:57-68``); the VM threads shard rows
-        internally within each decode."""
+        internally within each decode.
+
+        Both execution shapes now say what the chunk count bought
+        (the BENCH_r05 flat-sweep blind spot): the large-batch
+        per-chunk mode runs under a ``pool.fanout_s`` span whose
+        ``chunk_efficiency`` exposes that the chunks run serially (the
+        VM's internal row sharding is the parallelism), and the
+        small-batch path annotates ``chunk_mode=slice`` — one decode,
+        zero fan-out, so x1 vs x16 SHOULD be flat there."""
+        import time as _time
+
         from ..ops.arrow_build import compact_union_slices
+        from ..runtime import telemetry
         from ..runtime.chunking import chunk_bounds
+        from ..runtime.pool import fanout_stats
 
         bounds = chunk_bounds(len(data), num_chunks)
         if len(data) >= self._PER_CHUNK_ROWS * max(len(bounds), 1):
-            return [
-                self.decode(data[a:b], index_base=a) for a, b in bounds
-            ]
+            with fanout_stats(len(bounds), serial=True) as stats:
+                out = []
+                for a, b in bounds:
+                    t0 = _time.perf_counter()
+                    out.append(self.decode(data[a:b], index_base=a))
+                    stats.chunk(_time.perf_counter() - t0)
+            return out
+        telemetry.annotate(chunk_mode="slice")
         batch = self.decode(data)
         return [
             compact_union_slices(batch.slice(a, b - a)) for a, b in bounds

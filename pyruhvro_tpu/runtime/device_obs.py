@@ -161,6 +161,13 @@ def note_compile(fingerprint: str, kind: str, bucket: str, seconds: float,
         metrics.inc("device.recompile_storm")
         telemetry.annotate(recompile_storm=True)
         telemetry._flight_autodump("recompile_storm")
+        # a storming schema's device arms are withheld from the router
+        # for the churn window — the guard's verdict becomes a hard
+        # cost penalty instead of something the model must re-learn by
+        # paying more compiles
+        from . import costmodel
+
+        costmodel.penalize(fingerprint, churn_window_s())
 
 
 def _note_launch(fingerprint: str, kind: str, bucket: str,

@@ -28,13 +28,14 @@ from __future__ import annotations
 
 import os
 import threading
+import time
 from concurrent.futures import ProcessPoolExecutor, ThreadPoolExecutor
 from typing import Callable, List, Optional, Sequence
 
 from . import metrics, telemetry
 
 __all__ = ["get_pool", "map_chunks", "get_process_pool", "map_chunks_proc",
-           "pool_mode"]
+           "pool_mode", "process_available", "fanout_stats"]
 
 _pool = None
 _proc_pool = None
@@ -46,6 +47,60 @@ def pool_mode() -> str:
     """``thread`` (default) or ``process`` (PYRUHVRO_TPU_POOL)."""
     mode = os.environ.get("PYRUHVRO_TPU_POOL", "thread")
     return mode if mode in ("thread", "process") else "thread"
+
+
+def process_available() -> bool:
+    """Can a process-pool arm still be offered? False once the spawn
+    pool broke (``map_chunks_proc`` self-disables it) — the router must
+    stop proposing an arm every attempt of which degrades."""
+    return not _proc_broken
+
+
+class fanout_stats:
+    """Measure one chunk fan-out's parallel efficiency.
+
+    Opens a ``pool.fanout_s`` phase span; callers report each chunk's
+    wall seconds via :meth:`chunk`. On exit, ``chunk_efficiency`` =
+    (sum of chunk seconds) / (fan-out wall seconds × chunks) — 1.0 is
+    perfect overlap, 1/n is fully serialized — lands on the fan-out
+    span and in the ``pool.chunk_efficiency`` histogram; the flat
+    counter under the same key accumulates the SUM of efficiencies and
+    ``pool.eff_fanouts`` the count, so mean efficiency = sum / count
+    from any snapshot. This is the per-call view of the thread-scaling
+    blind spot: BENCH_r05's x1→x16 sweep was flat at ~3.6M rec/s and
+    nothing in a single call's telemetry said the fan-out wasn't
+    paying — now every fan-out span says exactly how much it paid.
+    """
+
+    __slots__ = ("chunks", "attrs", "_dts", "_ph", "_t0")
+
+    def __init__(self, chunks: int, **attrs):
+        self.chunks = chunks
+        self.attrs = attrs
+        self._dts: List[float] = []
+
+    def chunk(self, seconds: float) -> None:
+        self._dts.append(seconds)  # list.append is atomic under the GIL
+
+    def __enter__(self) -> "fanout_stats":
+        self._ph = telemetry.phase("pool.fanout_s", chunks=self.chunks,
+                                   **self.attrs)
+        self._ph.__enter__()
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        wall = time.perf_counter() - self._t0
+        span = self._ph.span
+        self._ph.__exit__(exc_type, exc, tb)
+        if exc_type is None and self._dts and wall > 0 and self.chunks > 0:
+            eff = min(1.0, sum(self._dts) / (wall * self.chunks))
+            metrics.inc("pool.eff_fanouts")
+            telemetry.observe_value("pool.chunk_efficiency", eff)
+            if span is not None:
+                span.attrs["chunk_efficiency"] = round(eff, 4)
+                span.attrs["speedup"] = round(sum(self._dts) / wall, 3)
+        return False
 
 
 def get_pool() -> ThreadPoolExecutor:
@@ -91,7 +146,7 @@ def map_chunks(fn: Callable, chunks: Sequence,
     attribution inside one snapshot."""
     metrics.inc("pool.chunks", len(chunks))
 
-    def run_one(i, chunk, inline=False):
+    def run_one(i, chunk, stats=None, inline=False):
         n = rows(chunk) if rows is not None else None
         attrs = {"chunk": i}
         if inline:
@@ -99,26 +154,37 @@ def map_chunks(fn: Callable, chunks: Sequence,
         if n is not None:
             attrs["rows"] = n
             metrics.inc("pool.worker_rows", float(n))
+        t0 = time.perf_counter()
         with metrics.record_deltas() as delta, \
                 telemetry.phase("pool.chunk_s", **attrs) as ph:
             out = fn(chunk)
-        if ph.span is not None and delta:
-            ph.span.attrs["counters"] = {
-                k: round(v, 9) for k, v in sorted(delta.items())
-            }
+        dt = time.perf_counter() - t0
+        if stats is not None:
+            stats.chunk(dt)
+        if ph.span is not None:
+            if delta:
+                ph.span.attrs["counters"] = {
+                    k: round(v, 9) for k, v in sorted(delta.items())
+                }
+            if n and dt > 0:
+                ph.span.attrs["rec_s"] = round(n / dt, 1)
         return out
 
     if len(chunks) == 1:
         return [run_one(0, chunks[0], inline=True)]
     metrics.inc("pool.fanouts")
+    # captured BEFORE the fanout span: chunk spans keep their
+    # established position as direct children of the call span; the
+    # pool.fanout_s span is a SIBLING summary carrying the efficiency
     parent = telemetry.current_span()
 
-    def run(i_chunk):
-        i, chunk = i_chunk
-        with telemetry.attach(parent):
-            return run_one(i, chunk)
+    with fanout_stats(len(chunks)) as stats:
+        def run(i_chunk):
+            i, chunk = i_chunk
+            with telemetry.attach(parent):
+                return run_one(i, chunk, stats)
 
-    return list(get_pool().map(run, enumerate(chunks)))
+        return list(get_pool().map(run, enumerate(chunks)))
 
 
 def map_chunks_proc(task: Callable, payloads: Sequence,
@@ -143,13 +209,20 @@ def map_chunks_proc(task: Callable, payloads: Sequence,
     if len(payloads) > 1:
         metrics.inc("pool.proc_fanouts")
     try:
-        futures = [get_process_pool().submit(task, p) for p in payloads]
-        # collect EVERY result before merging any worker telemetry: a
-        # fan-out that dies midway (broken pool, a worker's poison-datum
-        # error) must leave the parent's counters and quarantine
-        # collector untouched — the caller retries on the thread path,
-        # and partial merges would double-count the retried work
-        results = [fut.result() for fut in futures]
+        with fanout_stats(len(payloads), pool="process") as stats:
+            futures = [get_process_pool().submit(task, p)
+                       for p in payloads]
+            # collect EVERY result before merging any worker telemetry:
+            # a fan-out that dies midway (broken pool, a worker's
+            # poison-datum error) must leave the parent's counters and
+            # quarantine collector untouched — the caller retries on the
+            # thread path, and partial merges would double-count the
+            # retried work
+            results = [fut.result() for fut in futures]
+            for _result, payload in results:
+                dur = ((payload or {}).get("span") or {}).get("dur_s")
+                if dur:
+                    stats.chunk(float(dur))
         out = []
         for i, (result, payload) in enumerate(results):
             telemetry.merge_worker(payload)

@@ -61,10 +61,12 @@ from typing import Any, Dict, List, Optional
 from . import metrics
 
 __all__ = [
+    "SNAPSHOT_SCHEMA_VERSION",
     "Span",
     "root_span",
     "phase",
     "observe",
+    "observe_value",
     "current_span",
     "attach",
     "annotate",
@@ -91,6 +93,12 @@ _BUCKET_BOUNDS: tuple = tuple(
 )
 
 _MAX_SPANS = 64  # root spans retained for snapshot(); older ones are counted
+
+# snapshot document version (ISSUE 6): consumers (report/prom/perfetto/
+# route-report CLIs, CI artifact tooling) can tell what shape they hold;
+# UNVERSIONED legacy snapshots keep rendering — the field is additive.
+# 1 = PR 1-5 shape (implicit); 2 = adds schema_version + pid + routing.
+SNAPSHOT_SCHEMA_VERSION = 2
 
 
 def _env_int(name: str, default: int) -> int:
@@ -339,6 +347,19 @@ def observe(key: str, seconds: float, **attrs) -> None:
             parent.children.append(s)
 
 
+def observe_value(key: str, value: float) -> None:
+    """Counter + histogram for a DIMENSIONLESS value (e.g. a ratio like
+    ``pool.chunk_efficiency``): no child span is attached — a ratio has
+    no place on a time axis, and :func:`observe`'s ts back-shift would
+    misplace it. The flat counter accumulates the sum; histogram count
+    gives the denominator for a mean."""
+    metrics.inc(key, value)
+    if not _enabled:
+        return
+    with _lock:
+        _hist(key).observe(value)
+
+
 def annotate(**attrs) -> None:
     """Merge attributes into the current span (no-op outside a span)."""
     s = getattr(_tls, "span", None)
@@ -508,7 +529,8 @@ class worker_scope:
     ``snapshot()`` cover work done in other processes, whose counters
     and spans would otherwise be silently dropped with the worker."""
 
-    __slots__ = ("name", "attrs", "payload", "_rec", "_delta", "_root")
+    __slots__ = ("name", "attrs", "payload", "_rec", "_delta", "_root",
+                 "_robs")
 
     def __init__(self, name: str = "pool.worker", **attrs):
         self.name = name
@@ -516,14 +538,22 @@ class worker_scope:
         self.payload: Optional[Dict[str, Any]] = None
 
     def __enter__(self) -> "worker_scope":
+        from . import costmodel
+
         self._rec = metrics.record_deltas()
         self._delta = self._rec.__enter__()
+        # routing observations made in the worker (its API re-entries
+        # update the worker's own cost model) ship home too, so the
+        # parent's model learns from work done in other processes
+        self._robs = costmodel.record_observations()
+        self._robs.__enter__()
         self._root = root_span(self.name, pid=os.getpid(), **self.attrs)
         self._root.__enter__()
         return self
 
     def __exit__(self, exc_type, exc, tb):
         self._root.__exit__(exc_type, exc, tb)
+        self._robs.__exit__(exc_type, exc, tb)
         self._rec.__exit__(exc_type, exc, tb)
         span = self._root.span
         self.payload = {
@@ -532,6 +562,8 @@ class worker_scope:
             "counters": dict(self._delta),
             "span": span.to_dict() if span is not None else None,
         }
+        if self._robs.obs:
+            self.payload["routing"] = list(self._robs.obs)
         return False
 
 
@@ -571,6 +603,14 @@ def merge_worker(payload: Dict[str, Any], *, counters: bool = True) -> None:
         from . import quarantine as _quarantine
 
         _quarantine.extend_current(q)
+    robs = payload.get("routing")
+    if robs:
+        # the worker's routing observations feed the PARENT's cost
+        # model: cross-process learning rides the same delta machinery
+        # as counters and quarantine entries
+        from . import costmodel
+
+        costmodel.merge_observations(robs)
     sd = payload.get("span")
     if sd and _enabled:
         parent = getattr(_tls, "span", None)
@@ -600,9 +640,10 @@ def reset() -> None:
         _flight.clear()
         _roots_seen = 0
         _flight_last_auto = 0.0  # re-arm the auto-dump rate limiter
-    from . import device_obs
+    from . import device_obs, router
 
     device_obs.reset()
+    router.reset()
     with _trace_lock:
         if _trace_memo is not None:
             fh = _trace_memo[1]
@@ -626,25 +667,34 @@ def snapshot() -> Dict[str, Any]:
     aged out of the ring). When the device tier ran, a ``device`` section
     carries the jit-cache registry (per (schema fingerprint, shape
     bucket) compile/launch/cost detail) and per-device memory watermarks
-    (:mod:`.device_obs`); it is omitted entirely otherwise so snapshots
-    stay shape-compatible with pre-device-telemetry consumers."""
+    (:mod:`.device_obs`); when any call routed, a ``routing`` section
+    carries the decision ledger + learned cost model (:mod:`.router`).
+    Both are omitted entirely when empty so snapshots stay
+    shape-compatible with older consumers; ``schema_version`` stamps the
+    document shape (absent = pre-PR-6 legacy, still rendered by every
+    CLI)."""
     with _lock:
         hists = {k: h.summary() for k, h in sorted(_hists.items())}
         spans = [s.to_dict() for s in _spans]
         dropped = _roots_seen - len(_spans)
         flight_n = len(_flight)
     out = {
+        "schema_version": SNAPSHOT_SCHEMA_VERSION,
+        "pid": os.getpid(),
         "counters": metrics.snapshot(),
         "histograms": hists,
         "spans": spans,
         "spans_dropped": dropped,
         "flight_records": flight_n,
     }
-    from . import device_obs
+    from . import device_obs, router
 
     dev = device_obs.snapshot()
     if dev:
         out["device"] = dev
+    routing = router.snapshot_routing()
+    if routing:
+        out["routing"] = routing
     return out
 
 
@@ -1042,12 +1092,21 @@ def render_report(data: Dict[str, Any]) -> str:
             out += ["", "== pool workers =="]
             out.extend(f"{k:<36} {v:>14.0f}"
                        for k, v in sorted(workers.items()))
-        routes = {k: v for k, v in counters.items() if k.startswith("route.")}
+        routes = {k: v for k, v in counters.items()
+                  if k.startswith(("route.", "router."))}
         if routes:
             out += ["", "== routing =="]
             out.extend(f"{k:<36} {v:>10.0f}" for k, v in sorted(routes.items()))
+        routing = data.get("routing") or {}
+        if routing.get("ledger"):
+            out.append(
+                f"decision ledger: {len(routing['ledger'])} entr"
+                f"{'y' if len(routing['ledger']) == 1 else 'ies'} "
+                f"(autotune {'on' if routing.get('autotune') else 'off'}"
+                ") — render with the route-report / what-if subcommands")
         other = {k: v for k, v in counters.items()
-                 if not k.endswith("_s") and not k.startswith("route.")
+                 if not k.endswith("_s")
+                 and not k.startswith(("route.", "router."))
                  and not k.startswith(_PROF_PREFIXES)
                  and not k.startswith("device.")  # rendered above
                  and k not in workers}
@@ -1067,8 +1126,10 @@ def render_report(data: Dict[str, Any]) -> str:
 def main(argv: Optional[List[str]] = None) -> int:
     """CLI: ``report <file>`` (phase table) / ``prom <file>`` (text
     exposition) / ``perfetto <file> [-o out.json]`` (Chrome/Perfetto
-    trace-event timeline). ``<file>`` is a saved :func:`snapshot` JSON
-    or, for ``report``, a ``BENCH_DETAILS.json``."""
+    trace-event timeline) / ``route-report <file>`` (routing ledger +
+    learned cost model) / ``what-if <file>`` (ledger replay: where a
+    different arm would have won). ``<file>`` is a saved
+    :func:`snapshot` JSON or, for ``report``, a ``BENCH_DETAILS.json``."""
     import argparse
 
     ap = argparse.ArgumentParser(
@@ -1089,6 +1150,14 @@ def main(argv: Optional[List[str]] = None) -> int:
     p_perf.add_argument("path")
     p_perf.add_argument("-o", "--out",
                         help="write the trace here instead of stdout")
+    p_route = sub.add_parser(
+        "route-report", help="routing decision ledger + learned cost "
+                             "model from a snapshot JSON")
+    p_route.add_argument("path")
+    p_whatif = sub.add_parser(
+        "what-if", help="replay a snapshot's routing ledger: where "
+                        "would a different arm have won?")
+    p_whatif.add_argument("path")
     args = ap.parse_args(argv)
 
     def _usage_error(msg: str) -> int:
@@ -1112,7 +1181,25 @@ def main(argv: Optional[List[str]] = None) -> int:
         return _usage_error(
             f"{args.path} holds a JSON {type(data).__name__}, not a "
             "snapshot object")
-    if args.cmd == "report":
+    ver = data.get("schema_version")
+    if isinstance(ver, (int, float)) and ver > SNAPSHOT_SCHEMA_VERSION:
+        # forward-compat: a snapshot from a newer build renders
+        # best-effort instead of refusing (the converse — legacy
+        # UNVERSIONED snapshots — needs no warning at all)
+        print(f"note: snapshot schema_version {ver:g} is newer than "
+              f"this CLI ({SNAPSHOT_SCHEMA_VERSION}); rendering "
+              "best-effort", file=sys.stderr)
+    if args.cmd in ("route-report", "what-if"):
+        if not ({"routing", "counters", "histograms"} & set(data)):
+            return _usage_error(
+                "not a telemetry snapshot (expected 'routing'/"
+                "'counters'/'histograms' keys)")
+        from . import router
+
+        render = (router.render_route_report if args.cmd == "route-report"
+                  else router.render_what_if)
+        sys.stdout.write(render(data))
+    elif args.cmd == "report":
         if not ({"results", "counters", "histograms"} & set(data)):
             return _usage_error(
                 f"{args.path} has none of the expected keys "

@@ -6,6 +6,8 @@ Usage::
     python -m pyruhvro_tpu.telemetry report snapshot.json
     python -m pyruhvro_tpu.telemetry prom snapshot.json
     python -m pyruhvro_tpu.telemetry perfetto snapshot.json -o trace.json
+    python -m pyruhvro_tpu.telemetry route-report snapshot.json
+    python -m pyruhvro_tpu.telemetry what-if snapshot.json
 
 (``scripts/metrics_report.py`` is the tier-1-safe wrapper over the same
 entry point; ``perfetto`` output loads in ui.perfetto.dev /

@@ -87,7 +87,10 @@ def calibrate() -> float:
 def measure_cases(rows: int, chunks: int, reps: int) -> Dict[str, dict]:
     """The gate cases with bench.py's protocol (one untimed warmup, all
     reps recorded, band = {n, min_s, median_s}) — host tier only: the
-    gate must be deterministic wherever CI happens to run."""
+    gate must be deterministic wherever CI happens to run. (The routing
+    matrix builds its own ``backend="auto"`` case set in
+    :func:`route_matrix`, where the env knobs actually decide
+    something.)"""
     from bench import _band, _gen_kafka, _time_reps  # noqa: E402
     from pyruhvro_tpu.api import (
         deserialize_array,
@@ -212,6 +215,263 @@ def _device_counters() -> Dict[str, float]:
             if k.startswith("device.")}
 
 
+# -- autotuned-vs-static routing matrix (ISSUE 6) ---------------------------
+#
+# The acceptance harness for the router: measure the gate cases under
+# each STATIC env-knob configuration and under the router (trained in
+# this run, then measured with exploration off on the warm profile).
+# The router must not lose to ANY static config by more than
+# --route-tolerance (default 5%) median, per case. Writes
+# ROUTE_REPORT.json + a routing snapshot whose ledger the route-report/
+# what-if CLI render — CI uploads both.
+
+ROUTE_MATRIX_STATICS = [
+    # name -> env overrides; empty = the out-of-the-box static gates
+    ("static/thread", {}),
+    ("static/process", {"PYRUHVRO_TPU_POOL": "process"}),
+    ("static/host_only", {"PYRUHVRO_TPU_DEVICE_MIN_ROWS": "1000000000"}),
+]
+
+_ROUTE_ENV_KEYS = (
+    "PYRUHVRO_TPU_AUTOTUNE", "PYRUHVRO_TPU_EXPLORE", "PYRUHVRO_TPU_POOL",
+    "PYRUHVRO_TPU_DEVICE_MIN_ROWS", "PYRUHVRO_TPU_ROUTING_PROFILE",
+)
+
+
+class _route_env:
+    """Set routing env knobs for one matrix leg, restoring on exit (the
+    knobs are read per call, so in-process flips take effect)."""
+
+    def __init__(self, overrides: Dict[str, str]):
+        self.overrides = overrides
+
+    def __enter__(self):
+        self._saved = {k: os.environ.get(k) for k in _ROUTE_ENV_KEYS}
+        for k in _ROUTE_ENV_KEYS:
+            os.environ.pop(k, None)
+        os.environ.update(self.overrides)
+        return self
+
+    def __exit__(self, *exc):
+        for k, v in self._saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        return False
+
+
+def route_matrix(args) -> int:
+    from pyruhvro_tpu.api import (
+        deserialize_array,
+        deserialize_array_threaded,
+        serialize_record_batch,
+    )
+    from pyruhvro_tpu.runtime import costmodel, telemetry
+    from pyruhvro_tpu.utils.datagen import KAFKA_SCHEMA_JSON as K
+    from bench import _band, _gen_kafka  # noqa: E402
+
+    profile = os.path.join(REPO, "ROUTING_PROFILE.json")
+    report_path = os.path.join(REPO, "ROUTE_REPORT.json")
+    snap_path = os.path.join(REPO, "route_snapshot.json")
+
+    # pre-warm OUTSIDE any measured leg: native build + hot-schema
+    # specialization land here, not in whichever config runs first
+    datums = _gen_kafka(args.rows)
+    with _route_env({}):
+        for _ in range(3):
+            batch = deserialize_array(datums, K, backend="host")
+        for _ in range(2):
+            deserialize_array_threaded(datums, K, args.chunks,
+                                       backend="host")
+            serialize_record_batch(batch, K, args.chunks, backend="host")
+
+    def _case_key(op):
+        return case_key("kafka", op, "auto", args.rows, args.chunks)
+
+    cases = {
+        _case_key("deserialize"): lambda: deserialize_array_threaded(
+            datums, K, args.chunks, backend="auto"),
+        _case_key("deserialize_raise_policy"):
+            lambda: deserialize_array_threaded(
+                datums, K, args.chunks, backend="auto",
+                on_error="raise"),
+        _case_key("serialize"): lambda: serialize_record_batch(
+            batch, K, args.chunks, backend="auto"),
+    }
+
+    # TRAIN the router first — autotune on, aggressive exploration,
+    # fresh profile file (the matrix must prove learning, not luck)
+    try:
+        os.remove(profile)
+    except OSError:
+        pass
+    with _route_env({"PYRUHVRO_TPU_AUTOTUNE": "1",
+                     "PYRUHVRO_TPU_EXPLORE": "0.34",
+                     "PYRUHVRO_TPU_ROUTING_PROFILE": profile}):
+        telemetry.reset()
+        _log("[route-matrix] training the router (explore=0.34)")
+        for _ in range(max(3, args.reps)):
+            for fn in cases.values():
+                fn()
+        costmodel.save_profile(profile)
+    _log(f"[route-matrix] warm profile -> {profile}")
+
+    # MEASURE all configs round-robin, one rep each per round: every
+    # config shares the same machine-noise window, so slow drift on a
+    # busy runner cannot hand whichever leg ran first a fake win
+    configs = ROUTE_MATRIX_STATICS + [
+        ("router", {"PYRUHVRO_TPU_AUTOTUNE": "1",
+                    "PYRUHVRO_TPU_EXPLORE": "0",
+                    "PYRUHVRO_TPU_ROUTING_PROFILE": profile}),
+    ]
+    telemetry.reset()
+    costmodel.load_profile(profile)
+    times: Dict[tuple, list] = {}
+    for name, env in configs:  # untimed warmup round
+        with _route_env(env):
+            for fn in cases.values():
+                fn()
+    # case-major, config-inner: each rep times every config on the SAME
+    # case back to back (the bench overhead-measurement protocol), so a
+    # jitter spike hits whichever config it lands on, not a whole leg;
+    # the starting config rotates per rep so no config owns a position.
+    # Reps floor at 15: a verdict round costs milliseconds per config,
+    # and the 5% bar needs more samples than the wall-clock gate does
+    matrix_reps = max(args.reps, 15)
+    from pyruhvro_tpu.runtime import router as _router
+
+    arms: Dict[tuple, set] = {}  # (config, case) -> every arm executed
+    for key, fn in cases.items():
+        for rep in range(matrix_reps):
+            k = rep % len(configs)
+            for name, env in configs[k:] + configs[:k]:
+                with _route_env(env):
+                    t0 = time.perf_counter()
+                    fn()
+                    times.setdefault((name, key), []).append(
+                        time.perf_counter() - t0)
+                    e = _router.last_entry() or {}
+                    arms.setdefault((name, key), set()).add(
+                        e.get("arm", "?"))
+    results: Dict[str, Dict[str, dict]] = {}
+    for (name, key), ts in times.items():
+        results.setdefault(name, {})[key] = _band(ts)
+    for name, _env in configs:
+        for key, band in sorted(results.get(name, {}).items()):
+            _log(f"[route-matrix] {name} {key}: median "
+                 f"{band['median_s'] * 1e3:.3f} ms (n={band['n']})")
+    snap = telemetry.snapshot()
+    with open(snap_path, "w", encoding="utf-8") as f:
+        json.dump(snap, f, indent=1, default=str)
+    _log(f"[route-matrix] routing snapshot -> {snap_path}")
+
+    # the ledger-coverage acceptance: every AUTOTUNED call carries an
+    # entry with BOTH predicted and observed cost (static-config calls
+    # share the ring; they are ledgered too but may lack predictions
+    # for arms the model never saw)
+    ledger = (snap.get("routing") or {}).get("ledger") or []
+    routed = [e for e in ledger if e.get("autotune")]
+    covered = [e for e in routed
+               if e.get("predicted_s") is not None
+               and e.get("observed_s") is not None]
+    coverage = len(covered) / len(routed) if routed else 0.0
+    _log(f"[route-matrix] ledger coverage: {len(covered)}/{len(routed)} "
+         f"autotuned calls with predicted+observed cost")
+
+    tol = args.route_tolerance
+    verdicts = {}
+    failed = not routed or coverage < 1.0
+    if failed:
+        _log("[route-matrix] FAIL: ledger coverage below 100%")
+    for key in sorted(results["router"]):
+        router_med = results["router"][key]["median_s"]
+        statics = {n: r[key]["median_s"]
+                   for n, r in results.items()
+                   if n != "router" and key in r}
+        if not statics:
+            continue
+        best_name = min(statics, key=lambda n: statics[n])
+        best = statics[best_name]
+        # verdict on the MEDIAN of per-round paired ratios — router vs
+        # the best static config's time IN THE SAME round: machine
+        # drift hits every config of a round equally, so pairing
+        # cancels it. Paired against ONE config (the best by median),
+        # not a per-round min over all statics: min-of-k noisy samples
+        # is biased low, which would fail a router that exactly ties.
+        router_ts = times[("router", key)]
+        best_ts = times[(best_name, key)]
+        ratios = []
+        for rt, bt in zip(router_ts, best_ts):
+            if bt > 0:
+                ratios.append(rt / bt)
+        ratios.sort()
+        ratio = (ratios[len(ratios) // 2] if ratios
+                 else (router_med / best if best else None))
+        # best-of-N corroboration: a real routing mistake (wrong arm)
+        # is slower on EVERY rep, so min agrees with median; sub-ms
+        # scheduler jitter moves the median but not the floor — it must
+        # not fail the gate on a case where the router chose the same
+        # arm the static config ran
+        min_ratio = (min(router_ts) / min(best_ts)
+                     if best_ts and min(best_ts) > 0 else None)
+        # when the router and the winning static config EXECUTED the
+        # same arm on EVERY rep, identical code ran — there is no
+        # routing decision left to lose on, only timer noise between
+        # two measurements of one path; the timing verdict applies the
+        # moment the router ran ANY different arm mid-run (the model
+        # keeps learning during measurement, so it may switch)
+        r_arms = arms.get(("router", key)) or set()
+        s_arms = arms.get((best_name, key)) or set()
+        same_arm = (len(r_arms) == 1 and r_arms == s_arms
+                    and "?" not in r_arms)
+        lost = (not same_arm
+                and ratio is not None and ratio > 1.0 + tol
+                and (min_ratio is None or min_ratio > 1.0 + tol))
+        verdicts[key] = {
+            "router_median_s": round(router_med, 6),
+            "router_arms": sorted(r_arms),
+            "best_static": best_name,
+            "best_static_median_s": round(best, 6),
+            "best_static_arms": sorted(s_arms),
+            "same_arm": same_arm,
+            "ratio": round(ratio, 4) if ratio is not None else None,
+            "min_ratio": (round(min_ratio, 4)
+                          if min_ratio is not None else None),
+            "lost": lost,
+        }
+        _log(f"[route-matrix] {key}: router {router_med * 1e3:.3f} ms "
+             f"[{'/'.join(sorted(r_arms)) or '?'}] vs best static "
+             f"{best_name} {best * 1e3:.3f} ms "
+             f"[{'/'.join(sorted(s_arms)) or '?'}] "
+             f"(paired ratio {ratio:.3f}, min ratio "
+             f"{min_ratio if min_ratio is None else round(min_ratio, 3)}"
+             f"{', same arm' if same_arm else ''}) -> "
+             f"{'LOST' if lost else 'ok'}")
+        failed = failed or lost
+    report = {
+        "metric": "route_matrix",
+        "rows": args.rows,
+        "chunks": args.chunks,
+        "reps": args.reps,
+        "tolerance": tol,
+        "ledger_coverage": round(coverage, 4),
+        "configs": {n: {k: dict(b) for k, b in r.items()}
+                    for n, r in results.items()},
+        "verdicts": verdicts,
+        "pass": not failed,
+    }
+    with open(report_path, "w", encoding="utf-8") as f:
+        json.dump(report, f, indent=1, sort_keys=True)
+        f.write("\n")
+    _log(f"[route-matrix] report -> {report_path}")
+    print(json.dumps({"metric": "route_matrix", "pass": not failed,
+                      "ledger_coverage": round(coverage, 4),
+                      "cases": {k: v["ratio"]
+                                for k, v in verdicts.items()}}))
+    return 1 if failed else 0
+
+
 def main(argv: Optional[list] = None) -> int:
     ap = argparse.ArgumentParser(
         prog="perf_gate.py",
@@ -235,7 +495,17 @@ def main(argv: Optional[list] = None) -> int:
     ap.add_argument("--snapshot-out", default=DEFAULT_SNAPSHOT)
     ap.add_argument("--update-baseline", action="store_true",
                     help="reseed the baseline from this run and exit 0")
+    ap.add_argument("--route-matrix", action="store_true",
+                    help="autotuned-vs-static routing matrix: fail when "
+                         "the warm router loses any case to any static "
+                         "config by more than --route-tolerance")
+    ap.add_argument("--route-tolerance", type=float,
+                    default=float(os.environ.get(
+                        "PYRUHVRO_TPU_ROUTE_TOLERANCE", 0.05)))
     args = ap.parse_args(argv)
+
+    if args.route_matrix:
+        return route_matrix(args)
 
     try:
         with open(args.baseline, encoding="utf-8") as f:

@@ -1,0 +1,586 @@
+"""Routing decision ledger + telemetry-fed cost model (ISSUE 6).
+
+Covers the router's predict→act→observe→update loop (static cold start,
+model-driven arm choice, the deterministic exploration schedule, the
+recompile-storm device penalty), the ledger contract (every routed call
+carries predicted + observed cost), ROUTING_PROFILE.json persistence
+(load-at-import, cross-process merge, corrupt/stale cold start), the
+worker observation shipping, snapshot ``schema_version`` stamping with
+legacy-snapshot degradation, chunk-efficiency fan-out telemetry, and
+the route-report / what-if CLI surfaces.
+
+Runs entirely on the host tier — every assertion must hold with and
+without the native toolchain.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from pyruhvro_tpu import (
+    deserialize_array,
+    deserialize_array_threaded,
+    serialize_record_batch,
+    telemetry,
+)
+from pyruhvro_tpu.api import _route
+from pyruhvro_tpu.runtime import costmodel, metrics, router
+from pyruhvro_tpu.schema.cache import get_or_parse_schema
+from pyruhvro_tpu.utils.datagen import random_datums
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+SCHEMA = json.dumps({
+    "type": "record",
+    "name": "RouterT",
+    "fields": [
+        {"name": "a", "type": "long"},
+        {"name": "b", "type": "string"},
+    ],
+})
+
+
+def _datums(n=100, seed=11):
+    return random_datums(get_or_parse_schema(SCHEMA).ir, n, seed=seed)
+
+
+def _entry():
+    return get_or_parse_schema(SCHEMA)
+
+
+@pytest.fixture()
+def autotune(monkeypatch):
+    """Autotune on, exploration off, persistence disabled — the
+    deterministic greedy-router configuration for tests."""
+    monkeypatch.setenv("PYRUHVRO_TPU_AUTOTUNE", "1")
+    monkeypatch.setenv("PYRUHVRO_TPU_EXPLORE", "0")
+    monkeypatch.setenv("PYRUHVRO_TPU_ROUTING_PROFILE", "")
+    yield
+
+
+# ---------------------------------------------------------------------------
+# ledger contract
+# ---------------------------------------------------------------------------
+
+
+def test_every_call_emits_a_ledger_entry():
+    """Even with autotune OFF, every API call lands in the ledger with
+    its observed cost and static-mode provenance."""
+    data = _datums(50)
+    deserialize_array(data, SCHEMA, backend="host")
+    deserialize_array_threaded(data, SCHEMA, 4, backend="host")
+    snap = telemetry.snapshot()
+    assert snap["schema_version"] == telemetry.SNAPSHOT_SCHEMA_VERSION
+    ledger = snap["routing"]["ledger"]
+    assert len(ledger) == 2
+    for e in ledger:
+        assert e["mode"] == "static"
+        assert e["autotune"] is False
+        assert e["observed_s"] > 0
+        assert "predicted_s" in e  # None on a cold model, but present
+        assert e["arm"].startswith(("native/", "fallback/"))
+    assert ledger[0]["chunks"] == 1 and ledger[1]["chunks"] == 4
+    assert metrics.snapshot()["router.calls"] == 2
+
+
+def test_autotuned_calls_carry_predicted_and_observed(autotune):
+    """The acceptance contract: under PYRUHVRO_TPU_AUTOTUNE=1, 100% of
+    routed calls have a ledger entry; once the model is warm, every
+    entry carries BOTH predicted and observed cost."""
+    data = _datums(80)
+    for _ in range(4):
+        deserialize_array_threaded(data, SCHEMA, 2, backend="host")
+    ledger = telemetry.snapshot()["routing"]["ledger"]
+    assert len(ledger) == 4
+    assert all(e["observed_s"] > 0 for e in ledger)
+    # call 1 is the cold start; every later call predicts from history
+    for e in ledger[1:]:
+        assert e["predicted_s"] is not None
+        assert e["autotune"] is True
+    assert ledger[0]["mode"] == "cold_start"
+    assert all(e["mode"] == "model" for e in ledger[1:])
+
+
+def test_ledger_counterfactuals_cover_untaken_arms(autotune):
+    data = _datums(60)
+    deserialize_array_threaded(data, SCHEMA, 4, backend="host")
+    e = telemetry.snapshot()["routing"]["ledger"][-1]
+    assert e["arm"] not in e["counterfactual_s"]
+    # the other pool arm of the same tier is always a candidate on a
+    # multi-chunk host call
+    tier = e["tier"]
+    other = [a for a in e["counterfactual_s"] if a.startswith(tier + "/")]
+    assert other, e
+
+
+def test_ledger_entry_on_error(autotune):
+    with pytest.raises(ValueError):
+        deserialize_array([b"\x01"], SCHEMA, backend="host")
+    ledger = telemetry.snapshot()["routing"]["ledger"]
+    assert ledger and "error" in ledger[-1]
+    assert metrics.snapshot()["router.call_error"] == 1
+
+
+def test_root_span_annotated_with_arm_and_costs():
+    data = _datums(40)
+    deserialize_array(data, SCHEMA, backend="host")
+    root = telemetry.snapshot()["spans"][-1]
+    assert root["attrs"]["route_arm"].endswith("/c1/none")
+    assert root["attrs"]["route_obs_s"] > 0
+    assert root["attrs"]["route_mode"] == "static"
+
+
+# ---------------------------------------------------------------------------
+# decide(): cold start, model override, exploration, storm penalty
+# ---------------------------------------------------------------------------
+
+
+def _static_native(chunks):
+    tier, impl, reason = _route(_entry(), "host", 1000)
+    return (tier, impl, reason), {tier: impl}
+
+
+def test_cold_start_is_the_static_verdict(autotune):
+    static, cands = _static_native(4)
+    dec = router.decide(_entry(), "host", 1000, op="decode", chunks=4,
+                        candidates=cands, static=static)
+    assert dec.mode == "cold_start"
+    assert (dec.tier, dec.impl) == (static[0], static[1])
+    assert dec.pool == "thread"
+    assert dec.reason == static[2]
+
+
+def test_model_overrides_static_pool_choice(autotune):
+    """Seed the model so the process arm predicts cheaper: the router
+    must pick it (mode=model) and count the override."""
+    entry = _entry()
+    static, cands = _static_native(4)
+    tier = static[0]
+    band = costmodel.row_band(1000)
+    for _ in range(3):
+        costmodel.observe(entry.fingerprint, "decode", band,
+                          costmodel.arm_key(tier, 4, "thread"), 1000, 1.0)
+        costmodel.observe(entry.fingerprint, "decode", band,
+                          costmodel.arm_key(tier, 4, "process"), 1000,
+                          0.001)
+    dec = router.decide(entry, "host", 1000, op="decode", chunks=4,
+                        candidates=cands, static=static)
+    assert dec.mode == "model"
+    assert dec.pool == "process"
+    assert dec.reason == "autotune_model"
+    assert metrics.snapshot()["router.override"] == 1
+    # flipping the evidence flips the verdict
+    for _ in range(20):
+        costmodel.observe(entry.fingerprint, "decode", band,
+                          costmodel.arm_key(tier, 4, "process"), 1000,
+                          5.0)
+    dec = router.decide(entry, "host", 1000, op="decode", chunks=4,
+                        candidates=cands, static=static)
+    assert dec.pool == "thread"
+
+
+def test_explore_schedule_is_deterministic(autotune, monkeypatch):
+    """rate=0.5 → every 2nd decide per feature explores the
+    least-observed arm."""
+    monkeypatch.setenv("PYRUHVRO_TPU_EXPLORE", "0.5")
+    entry = _entry()
+    static, cands = _static_native(4)
+    tier = static[0]
+    band = costmodel.row_band(1000)
+    costmodel.observe(entry.fingerprint, "decode", band,
+                      costmodel.arm_key(tier, 4, "thread"), 1000, 0.001)
+    modes = []
+    for _ in range(6):
+        dec = router.decide(entry, "host", 1000, op="decode", chunks=4,
+                            candidates=cands, static=static)
+        modes.append(dec.mode)
+        if dec.explore:
+            # least-observed candidate = the never-tried process arm
+            # (or whichever arm has fewer observations at that point)
+            assert dec.arm in (costmodel.arm_key(tier, 4, "process"),
+                               costmodel.arm_key(tier, 4, "thread"))
+    assert modes[1::2] == ["explore"] * 3
+    assert all(m != "explore" for m in modes[0::2])
+
+
+def test_greedy_never_picks_an_unobserved_arm(autotune):
+    """Only exploration tries arms with no evidence — greedy sticks to
+    what it knows (cold start = static)."""
+    entry = _entry()
+    static, cands = _static_native(4)
+    tier = static[0]
+    band = costmodel.row_band(1000)
+    costmodel.observe(entry.fingerprint, "decode", band,
+                      costmodel.arm_key(tier, 4, "thread"), 1000, 0.5)
+    for _ in range(5):
+        dec = router.decide(entry, "host", 1000, op="decode", chunks=4,
+                            candidates=cands, static=static)
+        assert dec.pool == "thread"
+
+
+def test_storm_penalty_withholds_device_arm(autotune):
+    """A recompile-storm penalty drops the device arm from the offered
+    set even when it predicts cheapest."""
+    entry = _entry()
+    _tier, impl, _reason = _route(entry, "host", 1000)
+    band = costmodel.row_band(1000)
+    dev_arm = costmodel.arm_key("device", 1, "none")
+    nat_arm = costmodel.arm_key("native", 1, "none")
+    costmodel.observe(entry.fingerprint, "decode", band, dev_arm, 1000,
+                      0.0001)
+    costmodel.observe(entry.fingerprint, "decode", band, nat_arm, 1000,
+                      1.0)
+    cands = {"device": object(), "native": impl}
+    static = ("native", impl, None)
+    dec = router.decide(entry, "host", 1000, op="decode", chunks=1,
+                        candidates=cands, static=static)
+    assert dec.tier == "device"  # cheapest known arm wins...
+    costmodel.penalize(entry.fingerprint, window_s=60.0)
+    dec = router.decide(entry, "host", 1000, op="decode", chunks=1,
+                        candidates=cands, static=static)
+    assert dec.tier != "device"  # ...until the storm guard says no
+    counters = metrics.snapshot()
+    assert counters["router.storm_skip"] == 1
+    assert counters["router.device_penalty"] == 1
+
+
+def test_forced_device_survives_storm_penalty(autotune):
+    """backend='tpu' has only device arms: the storm penalty must not
+    empty the offered set (a forced backend runs, penalty or not)."""
+    entry = _entry()
+    dev = object()
+    costmodel.penalize(entry.fingerprint, window_s=60.0)
+    dec = router.decide(entry, "tpu", 1000, op="decode", chunks=1,
+                        candidates={"device": dev},
+                        static=("device", dev, "backend_tpu"))
+    assert dec.tier == "device" and dec.impl is dev
+
+
+def test_penalty_expires(autotune):
+    costmodel.penalize("fp123", window_s=0.0)
+    assert costmodel.device_penalized("fp123") is False
+
+
+def test_autotune_off_is_static_bit_for_bit(monkeypatch):
+    monkeypatch.delenv("PYRUHVRO_TPU_AUTOTUNE", raising=False)
+    entry = _entry()
+    static, cands = _static_native(4)
+    # even with overwhelming evidence for the process arm, off = static
+    band = costmodel.row_band(1000)
+    costmodel.observe(entry.fingerprint, "decode", band,
+                      costmodel.arm_key(static[0], 4, "process"), 1000,
+                      1e-6)
+    dec = router.decide(entry, "host", 1000, op="decode", chunks=4,
+                        candidates=cands, static=static)
+    assert (dec.tier, dec.impl, dec.reason) == static
+    assert dec.pool == "thread" and dec.mode == "static"
+
+
+def test_degraded_process_fanout_does_not_teach_the_model(monkeypatch):
+    """A process-arm call that fell back to threads is ledgered as
+    degraded and its timing must NOT update the process arm's cost."""
+    import pyruhvro_tpu.api as api
+
+    monkeypatch.setenv("PYRUHVRO_TPU_POOL", "process")
+    monkeypatch.setattr(api, "_proc_map", lambda *a, **k: None)
+    data = _datums(100)
+    entry = _entry()
+    out = deserialize_array_threaded(data, SCHEMA, 4, backend="host")
+    assert sum(b.num_rows for b in out) == 100
+    e = telemetry.snapshot()["routing"]["ledger"][-1]
+    assert e["pool"] == "process" and e["degraded"] is True
+    band = costmodel.row_band(100)
+    assert costmodel.predict(entry.fingerprint, "decode", band,
+                             e["arm"], 100) is None
+    assert metrics.snapshot()["router.degraded"] == 1
+
+
+def test_broken_pool_drops_process_arms_from_offers(autotune, monkeypatch):
+    from pyruhvro_tpu.runtime import pool
+
+    monkeypatch.setattr(pool, "_proc_broken", True)
+    entry = _entry()
+    static, cands = _static_native(4)
+    band = costmodel.row_band(1000)
+    # even with glowing (stale) evidence for the process arm, a broken
+    # pool means it is never offered
+    costmodel.observe(entry.fingerprint, "decode", band,
+                      costmodel.arm_key(static[0], 4, "process"), 1000,
+                      1e-6)
+    dec = router.decide(entry, "host", 1000, op="decode", chunks=4,
+                        candidates=cands, static=static)
+    assert dec.pool != "process"
+
+
+# ---------------------------------------------------------------------------
+# ROUTING_PROFILE.json persistence
+# ---------------------------------------------------------------------------
+
+
+def test_profile_roundtrip(tmp_path):
+    p = str(tmp_path / "prof.json")
+    costmodel.observe("fp", "decode", 10, "native/c4/thread", 1000, 0.01)
+    assert costmodel.save_profile(p) == p
+    before = costmodel.predict("fp", "decode", 10, "native/c4/thread",
+                               1000)
+    costmodel.reset()
+    assert costmodel.predict("fp", "decode", 10, "native/c4/thread",
+                             1000) is None
+    assert costmodel.load_profile(p) is True
+    after = costmodel.predict("fp", "decode", 10, "native/c4/thread",
+                              1000)
+    assert after == pytest.approx(before)
+
+
+def test_profile_cross_process_merge(tmp_path):
+    """save_profile is read-modify-write: two processes' knowledge
+    folds together (exact Welford combine) instead of clobbering."""
+    p = str(tmp_path / "prof.json")
+    other = {
+        "version": costmodel.PROFILE_VERSION,
+        "entries": [{"schema": "fp", "op": "decode", "band": 10,
+                     "arm": "native/c4/thread", "n": 4.0,
+                     "s_per_row": 2e-6, "m2": 0.0}],
+    }
+    with open(p, "w") as f:
+        json.dump(other, f)
+    costmodel.observe("fp", "decode", 10, "native/c4/thread", 1000, 0.004)
+    # local mean 4e-6 (n=1) + disk mean 2e-6 (n=4) -> 2.4e-6 (n=5)
+    costmodel.save_profile(p)
+    doc = json.load(open(p))
+    [e] = [e for e in doc["entries"] if e["arm"] == "native/c4/thread"]
+    assert e["n"] == pytest.approx(5.0)
+    assert e["s_per_row"] == pytest.approx(2.4e-6)
+
+
+def test_load_save_cycle_is_idempotent(tmp_path):
+    """save subtracts the loaded baseline: restart cycles must not
+    Welford-merge the same historical evidence twice."""
+    p = str(tmp_path / "prof.json")
+    with open(p, "w") as f:
+        json.dump({"version": costmodel.PROFILE_VERSION, "entries": [
+            {"schema": "fp", "op": "decode", "band": 10,
+             "arm": "native/c4/thread", "n": 100.0, "s_per_row": 1e-6,
+             "m2": 0.0}]}, f)
+    assert costmodel.load_profile(p)
+    costmodel.observe("fp", "decode", 10, "native/c4/thread", 1000, 0.002)
+    costmodel.save_profile(p)
+    doc = json.load(open(p))
+    [e] = doc["entries"]
+    assert e["n"] == pytest.approx(101.0)  # 100 loaded + 1 own, NOT 201
+    assert e["s_per_row"] == pytest.approx(
+        (100 * 1e-6 + 1 * 2e-6) / 101)
+    # a second save with no new observations changes nothing
+    costmodel.save_profile(p)
+    [e2] = json.load(open(p))["entries"]
+    assert e2["n"] == pytest.approx(101.0)
+
+
+def test_cold_start_fallback_avoids_device_and_process(autotune):
+    """Static arm withheld (storm penalty) + cold model: the fallback
+    must be the nearest safe arm, never a lexicographic accident that
+    lands on the device or the spawn pool."""
+    entry = _entry()
+    _tier, impl, _reason = _route(entry, "host", 1000)
+    cands = {"device": object(), "native": impl}
+    static = ("device", cands["device"], None)
+    costmodel.penalize(entry.fingerprint, window_s=60.0)
+    dec = router.decide(entry, "host", 1000, op="decode", chunks=4,
+                        candidates=cands, static=static)
+    assert dec.mode == "cold_start"
+    assert dec.tier == "native" and dec.pool == "thread"
+
+
+def test_profile_corrupt_and_stale_fall_back_cold(tmp_path):
+    p = str(tmp_path / "prof.json")
+    with open(p, "w") as f:
+        f.write("{not json")
+    assert costmodel.load_profile(p) is False
+    assert metrics.snapshot()["router.profile_load_error"] == 1
+    with open(p, "w") as f:
+        json.dump({"version": 999, "entries": []}, f)
+    assert costmodel.load_profile(p) is False
+    # cold start: nothing merged, nothing raised
+    assert costmodel.snapshot()["entries"] == []
+
+
+def test_profile_malformed_entries_skipped(tmp_path):
+    p = str(tmp_path / "prof.json")
+    with open(p, "w") as f:
+        json.dump({"version": costmodel.PROFILE_VERSION, "entries": [
+            {"schema": "fp"},                     # missing fields
+            {"schema": "fp", "op": "decode", "band": "x",
+             "arm": "a", "n": 1, "s_per_row": 1e-6},  # bad band
+            {"schema": "fp", "op": "decode", "band": 3,
+             "arm": "native/c1/none", "n": 2.0, "s_per_row": 1e-6,
+             "m2": 0.0},                           # good
+        ]}, f)
+    assert costmodel.load_profile(p) is True
+    assert len(costmodel.snapshot()["entries"]) == 1
+
+
+def test_profile_loads_at_import(tmp_path):
+    """A process launched with PYRUHVRO_TPU_AUTOTUNE=1 picks the warm
+    profile up at import, before the first call."""
+    p = str(tmp_path / "prof.json")
+    costmodel.observe("fp", "decode", 10, "native/c4/thread", 1000, 0.01)
+    costmodel.save_profile(p)
+    costmodel.reset()
+    env = dict(os.environ, PYRUHVRO_TPU_AUTOTUNE="1",
+               PYRUHVRO_TPU_ROUTING_PROFILE=p, JAX_PLATFORMS="cpu")
+    out = subprocess.run(
+        [sys.executable, "-c",
+         "from pyruhvro_tpu.runtime import costmodel as cm; "
+         "print(cm.predict('fp', 'decode', 10, 'native/c4/thread', "
+         "1000))"],
+        capture_output=True, text=True, env=env, cwd=REPO, timeout=120,
+    )
+    assert out.returncode == 0, out.stderr
+    assert out.stdout.strip() == "0.01"
+
+
+def test_worker_observations_merge_into_parent_model():
+    """worker_scope ships routing observations; merge_observations
+    folds them into (what stands in for) the parent process's model."""
+    data = _datums(30)
+    with telemetry.worker_scope("pool.worker", rows=30) as w:
+        deserialize_array(data, SCHEMA, backend="host")
+    assert w.payload["routing"], "worker payload must carry observations"
+    telemetry.reset()  # "the parent": a process with a cold model
+    assert costmodel.merge_observations(w.payload["routing"]) >= 1
+    [obs] = w.payload["routing"][:1]
+    schema_fp, op, band, arm = obs[0], obs[1], obs[2], obs[3]
+    assert costmodel.predict(schema_fp, op, band, arm, 30) is not None
+
+
+# ---------------------------------------------------------------------------
+# snapshot schema_version + legacy degradation (satellite)
+# ---------------------------------------------------------------------------
+
+
+def test_snapshot_is_versioned_and_routing_is_optional():
+    snap = telemetry.snapshot()
+    assert snap["schema_version"] == telemetry.SNAPSHOT_SCHEMA_VERSION
+    assert snap["pid"] == os.getpid()
+    assert "routing" not in snap  # nothing routed since reset
+    deserialize_array(_datums(10), SCHEMA, backend="host")
+    assert "routing" in telemetry.snapshot()
+
+
+def test_legacy_unversioned_snapshot_renders_everywhere():
+    """report/prom/perfetto must keep accepting pre-versioning
+    snapshots byte-for-byte (the committed sample predates the stamp)."""
+    path = os.path.join(REPO, "tests", "data",
+                        "telemetry_snapshot_sample.json")
+    with open(path) as f:
+        legacy = json.load(f)
+    assert "schema_version" not in legacy  # the fixture IS legacy
+    assert "== phase breakdown ==" in telemetry.render_report(legacy)
+    assert "pyruhvro_tpu_" in telemetry.prometheus(legacy)
+    trace = telemetry.perfetto_trace(legacy)
+    assert any(e["ph"] == "X" for e in trace["traceEvents"])
+
+
+def test_cli_degrades_on_legacy_and_flags_newer(tmp_path, capsys):
+    path = os.path.join(REPO, "tests", "data",
+                        "telemetry_snapshot_sample.json")
+    assert telemetry.main(["route-report", path]) == 0
+    assert "no routing" in capsys.readouterr().out
+    assert telemetry.main(["what-if", path]) == 0
+    assert "no routing" in capsys.readouterr().out
+    # a snapshot from a NEWER build renders best-effort with a note
+    newer = str(tmp_path / "new.json")
+    with open(newer, "w") as f:
+        json.dump({"schema_version": 99, "counters": {}, "histograms": {},
+                   "spans": []}, f)
+    assert telemetry.main(["report", newer]) == 0
+    assert "newer than this CLI" in capsys.readouterr().err
+    assert telemetry.main(["route-report", str(tmp_path / "nope.json")]) == 2
+
+
+def test_route_report_and_what_if_render_live_ledger(capsys):
+    data = _datums(60)
+    for _ in range(3):
+        deserialize_array_threaded(data, SCHEMA, 2, backend="host")
+    snap = telemetry.snapshot()
+    report = router.render_route_report(snap)
+    assert "== routing ==" in report
+    assert "/c2/" in report
+    whatif = router.render_what_if(snap)
+    assert "what-if" in whatif
+
+
+# ---------------------------------------------------------------------------
+# chunk-efficiency fan-out telemetry (satellite)
+# ---------------------------------------------------------------------------
+
+
+def test_fanout_records_chunk_efficiency(monkeypatch):
+    """A real thread fan-out (fallback tier fans decode chunks out on
+    the pool) records pool.chunk_efficiency + a pool.fanout_s span with
+    the efficiency attr."""
+    monkeypatch.setenv("PYRUHVRO_TPU_NO_NATIVE", "1")
+    data = _datums(200)
+    deserialize_array_threaded(data, SCHEMA, 4, backend="host")
+    snap = telemetry.snapshot()
+    counters = snap["counters"]
+    assert counters.get("pool.eff_fanouts", 0) >= 1
+    eff_mean = (counters["pool.chunk_efficiency"]
+                / counters["pool.eff_fanouts"])
+    assert 0.0 < eff_mean <= 1.0
+    assert "pool.chunk_efficiency" in snap["histograms"]
+    fanouts = [s for s in _walk_spans(snap) if s["name"] == "pool.fanout_s"]
+    assert fanouts
+    assert 0.0 < fanouts[-1]["attrs"]["chunk_efficiency"] <= 1.0
+    assert fanouts[-1]["attrs"]["speedup"] > 0
+
+
+def _walk_spans(snap):
+    out = []
+
+    def walk(s):
+        out.append(s)
+        for c in s.get("children", []):
+            walk(c)
+
+    for root in snap.get("spans", []):
+        walk(root)
+    return out
+
+
+def test_slice_mode_is_annotated(monkeypatch):
+    """The native tier's small-batch chunked decode does NOT fan out
+    (decode once + slice) and says so on the span."""
+    pytest.importorskip("pyruhvro_tpu.hostpath")
+    from pyruhvro_tpu.hostpath import native_available
+
+    if not native_available():
+        pytest.skip("no native toolchain")
+    data = _datums(100)
+    deserialize_array_threaded(data, SCHEMA, 4, backend="host")
+    root = telemetry.snapshot()["spans"][-1]
+    assert root["attrs"].get("chunk_mode") == "slice"
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: the autotuned router serves real calls
+# ---------------------------------------------------------------------------
+
+
+def test_autotuned_end_to_end_stays_correct(autotune):
+    """Warm-model routing returns the same batches as static routing."""
+    data = _datums(120, seed=3)
+    expect = deserialize_array_threaded(data, SCHEMA, 3, backend="host")
+    for _ in range(3):
+        got = deserialize_array_threaded(data, SCHEMA, 3, backend="host")
+    assert [b.num_rows for b in got] == [b.num_rows for b in expect]
+    for g, e in zip(got, expect):
+        assert g.equals(e)
+    batch = deserialize_array(data, SCHEMA, backend="host")
+    [arr] = serialize_record_batch(batch, SCHEMA, 1, backend="host")
+    assert len(arr) == 120
+    ledger = telemetry.snapshot()["routing"]["ledger"]
+    assert all(e["observed_s"] > 0 for e in ledger)
