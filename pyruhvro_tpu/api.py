@@ -50,7 +50,7 @@ from .fallback.decoder import (
 )
 from .fallback.encoder import compile_encoder_plan, encode_record_batch
 from .fallback.io import MalformedAvro, max_datum_bytes, shift_malformed
-from .runtime import metrics, quarantine, router, telemetry
+from .runtime import metrics, quarantine, router, sampling, telemetry
 from .runtime.chunking import bounds_rows, chunk_bounds
 from .runtime.pool import map_chunks, map_chunks_proc
 from .schema.cache import SchemaEntry, get_or_parse_schema
@@ -688,8 +688,11 @@ def deserialize_array(
     _check_on_error(on_error)
     entry = get_or_parse_schema(schema)
     with telemetry.root_span("api.deserialize_array", rows=len(data),
-                             backend=backend, schema=entry.fingerprint):
+                             backend=backend, schema=entry.fingerprint), \
+            sampling.call_scope("decode", entry.fingerprint,
+                                len(data)) as smp:
         dec = _decide(entry, backend, len(data), op="decode")
+        dec.sampled = smp.sampled
         try:
             out = _deserialize_one(dec, entry, data, on_error,
                                    return_errors)
@@ -750,9 +753,12 @@ def deserialize_array_threaded(
     bounds = chunk_bounds(len(data), num_chunks)
     with telemetry.root_span("api.deserialize_array_threaded",
                              rows=len(data), chunks=num_chunks,
-                             backend=backend, schema=entry.fingerprint):
+                             backend=backend, schema=entry.fingerprint), \
+            sampling.call_scope("decode", entry.fingerprint,
+                                len(data)) as smp:
         dec = _decide(entry, backend, len(data), op="decode",
                       chunks=len(bounds))
+        dec.sampled = smp.sampled
         try:
             out = _deserialize_chunks(dec, entry, data, schema,
                                       num_chunks, bounds, on_error,
@@ -893,9 +899,12 @@ def serialize_record_batch(
     bounds = chunk_bounds(batch.num_rows, num_chunks)
     with telemetry.root_span("api.serialize_record_batch",
                              rows=batch.num_rows, chunks=num_chunks,
-                             backend=backend, schema=entry.fingerprint):
+                             backend=backend, schema=entry.fingerprint), \
+            sampling.call_scope("encode", entry.fingerprint,
+                                batch.num_rows) as smp:
         dec = _decide(entry, backend, batch.num_rows, op="encode",
                       chunks=len(bounds), need_encode=True)
+        dec.sampled = smp.sampled
         try:
             out = _serialize_chunks(dec, entry, batch, schema,
                                     num_chunks, bounds, on_error,

@@ -32,12 +32,15 @@ def native_available() -> bool:
     return load_host_codec() is not None
 
 
-def _drain_native_prof(*mods) -> None:
+def _drain_native_prof(*mods, scale: float = 1.0) -> None:
     """Fold the native-tier profiler's per-opcode counters into the
     telemetry layer (``vm.op.*`` / ``vm.encop.*`` / ``extract.op.*``
     hit counts plus ``*_s`` self-time seconds). No-op on the default
-    (unprofiled) builds — only the PYRUHVRO_TPU_NATIVE_PROF=1 variants
-    export ``prof_drain``."""
+    (unprofiled) builds — only the profiled variants export
+    ``prof_drain``. ``scale`` is the adaptive sampler's weight
+    correction: a deep-sampled call stands in for ~period calls, so its
+    drained hits/seconds multiply by the period — the merged totals
+    then ESTIMATE what an always-profiled run would have recorded."""
     from ..runtime import metrics
 
     for mod in mods:
@@ -46,9 +49,9 @@ def _drain_native_prof(*mods) -> None:
             continue
         for key, (hits, ns) in drain().items():
             if hits:
-                metrics.inc(key, float(hits))
+                metrics.inc(key, float(hits) * scale)
             if ns:
-                metrics.inc(key + "_s", ns * 1e-9)
+                metrics.inc(key + "_s", ns * 1e-9 * scale)
 
 
 def _vm_threads(nthreads: int) -> int:
@@ -146,14 +149,31 @@ class NativeHostCodec:
         from ..runtime import telemetry
 
         n = len(data)
+        # adaptive deep sampling (runtime/sampling.py): a sampled call
+        # decodes through the per-opcode-profiled interpreter build —
+        # even when a specialized engine is warm, because straight-line
+        # code has nothing to attribute — and its drained self-times
+        # merge weight-corrected (x period) into the live registry
+        deep_mod = None
+        if not self._prof:
+            from ..runtime import sampling
+
+            if sampling.deep_active():
+                deep_mod = sampling.prof_codec_module()
         with telemetry.phase("host.decode_s", rows=n):
             self._maybe_specialize(n)
             # records decode straight from the caller's bytes objects (span
             # collection in C++, ≙ extract_bytes_list src/lib.rs:29-33) —
             # no concatenation pass exists on this path at all
             with telemetry.phase("host.vm_s",
-                                 specialized=self._spec is not None):
-                if self._spec is not None:
+                                 specialized=(self._spec is not None
+                                              and deep_mod is None)):
+                if deep_mod is not None:
+                    bufs, err_rec, err_bits = deep_mod.decode(
+                        self.prog.ops, self.prog.coltypes, data,
+                        _vm_threads(nthreads)
+                    )
+                elif self._spec is not None:
                     bufs, err_rec, err_bits = self._spec.decode(
                         self.prog.coltypes, data, nthreads
                     )
@@ -164,6 +184,12 @@ class NativeHostCodec:
                     )
             if self._prof:
                 _drain_native_prof(self._mod)
+            elif deep_mod is not None:
+                from ..runtime import sampling
+
+                sampling.note_deep_ran()
+                _drain_native_prof(deep_mod,
+                                   scale=sampling.deep_weight())
             if err_rec >= 0:
                 bit = err_bits & -err_bits
                 raise malformed_record(

@@ -53,6 +53,8 @@ __all__ = [
     "tick",
     "penalize",
     "device_penalized",
+    "penalize_arm",
+    "arm_penalized",
     "record_observations",
     "merge_observations",
     "snapshot",
@@ -84,6 +86,12 @@ _loaded: Dict[Tuple[str, str, int, str], List[float]] = {}
 _decides: Dict[Tuple[str, str, int], int] = {}
 # schema_fp -> monotonic expiry of the recompile-storm device penalty
 _penalties: Dict[str, float] = {}
+# (schema_fp, arm) -> (monotonic expiry, cost factor) of a per-arm
+# penalty (latency drift: the drifting arm's predictions are INFLATED
+# by the measured regression ratio while it re-learns — soft, unlike
+# the hard device-storm withholding, because "this arm got 1.6x
+# slower" must not force the router onto an arm predicted 4x worse)
+_arm_penalties: Dict[Tuple[str, str], Tuple[float, float]] = {}
 _persist_armed = False
 _tls = threading.local()
 
@@ -184,12 +192,14 @@ def predict(schema: str, op: str, band: int, arm: str,
             rows: int) -> Optional[float]:
     """Predicted wall seconds for ``rows`` on this arm, or None when the
     arm has never been observed at this feature (the router never picks
-    an unobserved arm greedily — only the exploration schedule does)."""
+    an unobserved arm greedily — only the exploration schedule does).
+    An active drift penalty (:func:`penalize_arm`) inflates the figure
+    by its factor."""
     with _lock:
         st = _stats.get((schema, op, int(band), arm))
         if st is None or st[0] <= 0:
             return None
-        return st[1] * max(int(rows), 1)
+        return st[1] * max(int(rows), 1) * _arm_factor_locked(schema, arm)
 
 
 def obs_count(schema: str, op: str, band: int, arm: str) -> float:
@@ -231,6 +241,44 @@ def device_penalized(schema: str) -> bool:
             del _penalties[schema]
             return False
         return True
+
+
+def penalize_arm(schema: str, arm: str, window_s: float = 60.0,
+                 factor: float = 2.0) -> None:
+    """Inflate ONE arm's predictions by ``factor`` for ``window_s``
+    seconds — the latency-drift detector's verdict (:mod:`.drift`): a
+    drifting arm keeps its learned estimate (which drift just proved
+    stale-low) and would keep winning greedily on it, so its predicted
+    cost carries the measured regression ratio until fresh evidence
+    accumulates. Soft by design: the router leaves the arm only when
+    an alternative is predicted cheaper even against the inflated
+    figure — a 1.6x drift must not force traffic onto a 4x-worse arm
+    (the failure mode a hard withhold showed in the route matrix)."""
+    with _lock:
+        _arm_penalties[(schema, arm)] = (
+            time.monotonic() + max(0.0, window_s), max(1.0, factor))
+    metrics.inc("router.arm_penalty")
+
+
+def _arm_factor_locked(schema: str, arm: str) -> float:
+    """Current penalty factor (1.0 = none); callers hold ``_lock``."""
+    ent = _arm_penalties.get((schema, arm))
+    if ent is None:
+        return 1.0
+    until, factor = ent
+    if time.monotonic() >= until:
+        del _arm_penalties[(schema, arm)]
+        return 1.0
+    return factor
+
+
+def arm_penalty(schema: str, arm: str) -> float:
+    with _lock:
+        return _arm_factor_locked(schema, arm)
+
+
+def arm_penalized(schema: str, arm: str) -> bool:
+    return arm_penalty(schema, arm) > 1.0
 
 
 # ---------------------------------------------------------------------------
@@ -295,9 +343,14 @@ def snapshot() -> Dict[str, Any]:
         ]
         pen = {k: round(v - now, 3) for k, v in _penalties.items()
                if v > now}
+        apen = {f"{k[0]}|{k[1]}": {"remaining_s": round(v[0] - now, 3),
+                                   "factor": v[1]}
+                for k, v in _arm_penalties.items() if v[0] > now}
     doc: Dict[str, Any] = {"version": PROFILE_VERSION, "entries": entries}
     if pen:
         doc["device_penalties_s"] = pen  # runtime-only; never persisted
+    if apen:
+        doc["arm_penalties"] = apen  # runtime-only; never persisted
     return doc
 
 
@@ -522,6 +575,7 @@ def reset() -> None:
         _loaded.clear()
         _decides.clear()
         _penalties.clear()
+        _arm_penalties.clear()
 
 
 # warm start: a process launched with autotune on picks its profile up

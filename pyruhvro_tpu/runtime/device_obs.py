@@ -92,12 +92,26 @@ def sync_mode() -> bool:
     the pre-PR-5 async dispatch (d2h carries the wait). Unset = auto:
     bounded, except with telemetry off (the off path must stay at bare
     dispatch cost) or behind a probed-remote interconnect (the extra
-    sync would cost a full tunnel RTT per call)."""
+    sync would cost a full tunnel RTT per call). A deep-sampled call
+    (:mod:`.sampling`) is ALWAYS bounded — precise launch timing is the
+    whole point of sampling it, and the adaptive budget already pays
+    for the sync."""
+    from . import sampling
+
+    deep = sampling.deep_active()
     v = os.environ.get("PYRUHVRO_TPU_DEVICE_SYNC", "").strip().lower()
     if v in ("1", "on", "true"):
+        if deep:
+            # the sync IS this tier's deep path; a sampled call must
+            # register it even when the env already forces syncing, or
+            # the sampler would treat every device sample as skipped
+            sampling.note_deep_ran()
         return True
     if v in ("0", "off", "false"):
         return False
+    if deep:
+        sampling.note_deep_ran()
+        return True
     if not telemetry.enabled():
         return False
     try:
@@ -159,6 +173,7 @@ def note_compile(fingerprint: str, kind: str, bucket: str, seconds: float,
             log.clear()
     if storm:
         metrics.inc("device.recompile_storm")
+        metrics.mark("recompile_storm")  # the live /healthz bit
         telemetry.annotate(recompile_storm=True)
         telemetry._flight_autodump("recompile_storm")
         # a storming schema's device arms are withheld from the router

@@ -20,10 +20,12 @@ import time
 from collections import defaultdict
 from typing import Dict
 
-__all__ = ["inc", "merge", "snapshot", "reset", "timer", "record_deltas"]
+__all__ = ["inc", "merge", "snapshot", "reset", "timer", "record_deltas",
+           "mark", "mark_age", "DeferredCount"]
 
 _lock = threading.Lock()
 _counters: Dict[str, float] = defaultdict(float)
+_marks: Dict[str, float] = {}
 _tls = threading.local()
 
 
@@ -71,6 +73,67 @@ class record_deltas:
         return False
 
 
+def mark(key: str) -> None:
+    """Timestamp an EVENT (quarantine storm, recompile storm, SLO
+    breach…). Unlike counters — which only ever grow — a mark carries
+    WHEN, which is what the live health endpoint needs: "a storm
+    happened at some point" is history, "a storm happened 4 s ago" is a
+    page. Same cost model as :func:`inc`: one lock + dict store."""
+    with _lock:
+        _marks[key] = time.monotonic()
+
+
+def mark_age(key: str):
+    """Seconds since ``key`` was last marked, or None (never marked /
+    cleared by :func:`reset`)."""
+    with _lock:
+        ts = _marks.get(key)
+    return None if ts is None else max(0.0, time.monotonic() - ts)
+
+
+class DeferredCount:
+    """A counter that may be bumped from SIGNAL context, where
+    :func:`inc` could deadlock (the handler may have interrupted a
+    frame that holds the non-reentrant metrics lock). A monotonic
+    total/reported pair instead of a reset-to-zero pending count: the
+    signal side only ever INCREMENTS (plain int ``+=`` on the main
+    thread, atomic under the GIL), and flushers advance ``reported``
+    under a lock — two concurrent flushers cannot double-count a
+    delta, and a handler firing mid-flush is simply picked up by the
+    next one."""
+
+    __slots__ = ("key", "_total", "_reported", "_lock")
+
+    def __init__(self, key: str):
+        self.key = key
+        self._total = 0
+        self._reported = 0
+        self._lock = threading.Lock()
+
+    def bump(self, n: int = 1) -> None:
+        """Signal-context side: increment only, never a lock."""
+        self._total += n
+
+    def flush(self) -> None:
+        """Normal-thread side: publish any un-reported delta via
+        :func:`inc`. Lock-free fast path — both fields only ever
+        advance, so an equal read means nothing to flush (the ~100%
+        case on per-call paths)."""
+        if self._total == self._reported:
+            return
+        with self._lock:
+            delta = self._total - self._reported
+            if delta <= 0:
+                return
+            self._reported += delta
+        inc(self.key, float(delta))
+
+    def reset(self) -> None:
+        with self._lock:
+            self._total = 0
+            self._reported = 0
+
+
 def snapshot() -> Dict[str, float]:
     with _lock:
         return dict(_counters)
@@ -79,6 +142,7 @@ def snapshot() -> Dict[str, float]:
 def reset() -> None:
     with _lock:
         _counters.clear()
+        _marks.clear()
 
 
 class timer:

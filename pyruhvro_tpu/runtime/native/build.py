@@ -205,6 +205,15 @@ def load_host_codec():
     return _load_maybe_prof("_pyruhvro_hostcodec", "host_codec.cpp")
 
 
+def load_host_codec_prof():
+    """The per-opcode-profiled host VM build UNCONDITIONALLY (no env
+    knob), or None. The adaptive deep sampler (``runtime/sampling.py``)
+    runs individual calls through it while the rest of the process
+    stays on the unprofiled build — both variants coexist as separate
+    cached binaries exporting the same surface."""
+    return _load("_pyruhvro_hostcodec", "host_codec.cpp", prof=True)
+
+
 def load_extract():
     """The Arrow-native extractor / fused encoder, or None if the
     toolchain is missing (callers keep the Python extractor)."""

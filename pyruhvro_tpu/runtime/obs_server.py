@@ -1,0 +1,343 @@
+"""In-process live observability plane: /metrics /healthz /snapshot /flight.
+
+Every observability layer before this one was post-hoc — snapshots
+written to files, read after the fact. This is the *live* surface: an
+opt-in, stdlib-only background HTTP server (``http.server`` on a daemon
+thread, bound to 127.0.0.1 by default) that renders the **live**
+telemetry registry per request:
+
+* ``GET /metrics`` — Prometheus text exposition, byte-identical to
+  ``telemetry.prometheus()`` on the same registry state (it IS the same
+  function), so existing scrape configs/dashboards keep working;
+* ``GET /healthz`` — readiness + degradation bits as JSON, HTTP 200
+  when serviceable, 503 while an active storm / SLO breach / latency
+  drift makes the process unhealthy (see :func:`health`);
+* ``GET /snapshot`` — the full ``telemetry.snapshot()``
+  (schema_version 2) as JSON;
+* ``GET /flight`` — the flight recorder ring (``telemetry.flight_dump()``).
+
+Enable with ``PYRUHVRO_TPU_OBS_PORT=<port>`` (``0`` = any free port; the
+chosen port is logged and available as ``server().port``) — the server
+starts when the library is imported, costs nothing per call (it only
+reads, on its own thread, under the same locks every exporter already
+takes), and never takes the process down: handler errors return 500 and
+are counted, not raised.
+
+The same server class also serves a SAVED snapshot dict (``python -m
+pyruhvro_tpu.telemetry serve snapshot.json``) so a post-mortem file can
+be pointed at the same dashboards.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, Optional, Tuple
+
+from . import metrics
+
+__all__ = [
+    "ObsServer",
+    "health",
+    "start",
+    "stop",
+    "server",
+    "start_from_env",
+]
+
+# how long (seconds) a storm/drift event keeps /healthz unhealthy after
+# it fired — long enough for a scraper on a normal interval to see it,
+# short enough that a recovered process goes green again on its own
+_DEFAULT_HEALTH_WINDOW_S = 60.0
+
+_lock = threading.Lock()
+_server: Optional["ObsServer"] = None
+
+
+def _health_window_s() -> float:
+    try:
+        v = float(os.environ.get("PYRUHVRO_TPU_HEALTH_WINDOW", "")
+                  or _DEFAULT_HEALTH_WINDOW_S)
+    except ValueError:
+        v = _DEFAULT_HEALTH_WINDOW_S
+    return max(0.0, v)
+
+
+def _native_state() -> str:
+    """Native-extension state WITHOUT triggering a JIT build: a health
+    probe must never spend seconds in g++."""
+    try:
+        from .native import build
+
+        probed = False
+        # either build variant serves the native tier (the profiled
+        # one is what PYRUHVRO_TPU_NATIVE_PROF / the deep sampler load)
+        for key in ("_pyruhvro_hostcodec", "_pyruhvro_hostcodec@prof"):
+            if key in build._modules:
+                probed = True
+                if build._modules[key] is not None:
+                    return "loaded"
+        return "unavailable" if probed else "unprobed"
+    except Exception:
+        return "unknown"
+
+
+def _device_state() -> str:
+    """Device-backend state from already-resolved probes only (never
+    initializes JAX)."""
+    import sys
+
+    codec = sys.modules.get("pyruhvro_tpu.ops.codec")
+    if codec is None:
+        return "unprobed"
+    try:
+        rtt = getattr(codec, "_rtt_result", None)
+        if rtt:
+            return "remote" if rtt[0] > 0.010 else "local"
+    except Exception:
+        pass
+    return "imported"
+
+
+def health() -> Tuple[int, Dict[str, Any]]:
+    """-> (http_status, body). Unhealthy (503) bits are ACTIVE
+    conditions: a quarantine or recompile storm / latency drift within
+    the health window, or a currently-breached SLO. Degraded-but-
+    serviceable facts (broken spawn pool, native tier unavailable)
+    stay 200 — the process still answers calls — but are reported so
+    a dashboard can alarm on them separately."""
+    from . import slo
+    from .pool import process_available
+
+    window = _health_window_s()
+
+    def recent(key: str) -> bool:
+        age = metrics.mark_age(key)
+        return age is not None and age <= window
+
+    slo_breached = slo.breached()
+    unhealthy = {
+        "quarantine_storm": recent("quarantine_storm"),
+        "recompile_storm": recent("recompile_storm"),
+        "latency_drift": recent("latency_drift"),
+        "slo_breach": bool(slo_breached),
+    }
+    degraded = {
+        "spawn_pool_broken": not process_available(),
+        "native_ext": _native_state(),
+        "device_backend": _device_state(),
+    }
+    ready = not any(unhealthy.values())
+    status = ("ok" if ready and not degraded["spawn_pool_broken"]
+              else "degraded" if ready else "unhealthy")
+    body: Dict[str, Any] = {
+        "status": status,
+        "ready": ready,
+        "pid": os.getpid(),
+        "health_window_s": window,
+        "unhealthy_bits": unhealthy,
+        "degraded_bits": degraded,
+    }
+    if slo_breached:
+        body["slo_breached"] = slo_breached
+    return (200 if ready else 503), body
+
+
+class _Handler(BaseHTTPRequestHandler):
+    server_version = "pyruhvro-tpu-obs"
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, fmt, *args):  # silent: a scrape per 15s must
+        pass                            # not spam the service's stderr
+
+    def _send(self, code: int, body: bytes, ctype: str) -> None:
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _send_json(self, code: int, doc: Any) -> None:
+        self._send(code, json.dumps(doc, indent=1, default=str).encode(),
+                   "application/json")
+
+    def do_GET(self):  # noqa: N802 — BaseHTTPRequestHandler contract
+        path = self.path.split("?", 1)[0].rstrip("/") or "/"
+        snap_doc = self.server._static_snapshot  # type: ignore[attr-defined]
+        try:
+            metrics.inc("obs.requests")
+            if path == "/metrics":
+                from . import telemetry
+
+                text = telemetry.prometheus(snap_doc)  # None = live
+                self._send(200, text.encode(),
+                           "text/plain; version=0.0.4; charset=utf-8")
+            elif path == "/healthz":
+                if snap_doc is not None:
+                    code, body = _static_health(snap_doc)
+                else:
+                    code, body = health()
+                self._send_json(code, body)
+            elif path == "/snapshot":
+                if snap_doc is not None:
+                    self._send_json(200, snap_doc)
+                else:
+                    from . import telemetry
+
+                    self._send_json(200, telemetry.snapshot())
+            elif path == "/flight":
+                if snap_doc is not None:
+                    self._send_json(200, {
+                        "static": True,
+                        "records": [],
+                        "note": "flight records are not part of saved "
+                                "snapshots; use the live endpoint or a "
+                                "flight dump file",
+                    })
+                else:
+                    from . import telemetry
+
+                    self._send_json(200, telemetry.flight_dump())
+            else:
+                self._send_json(404, {
+                    "error": f"unknown path {path!r}",
+                    "endpoints": ["/metrics", "/healthz", "/snapshot",
+                                  "/flight"],
+                })
+        except BrokenPipeError:
+            pass  # scraper went away mid-response
+        except Exception as e:  # noqa: BLE001 — the server must survive
+            metrics.inc("obs.handler_error")
+            try:
+                self._send_json(500, {"error": repr(e)})
+            except OSError:
+                pass
+
+
+def _static_health(snap: Dict[str, Any]) -> Tuple[int, Dict[str, Any]]:
+    """Health computed from a SAVED snapshot: no liveness to assert,
+    but the recorded SLO/storm state still renders (a breached saved
+    snapshot serves 503 so alert rules can be tested against files)."""
+    slo_sec = snap.get("slo") or {}
+    breached = slo_sec.get("breached") or []
+    counters = snap.get("counters") or {}
+    body = {
+        "status": "unhealthy" if breached else "static",
+        "ready": not breached,
+        "static": True,
+        "pid": snap.get("pid"),
+        "schema_version": snap.get("schema_version"),
+        "recorded": {
+            "quarantine_storms": (
+                counters.get("decode.quarantine_storms", 0)
+                + counters.get("encode.quarantine_storms", 0)),
+            "recompile_storms": counters.get("device.recompile_storm", 0),
+            "drift_detections": counters.get("drift.detected", 0),
+            "slo_breaches": counters.get("slo.breach", 0),
+        },
+    }
+    if breached:
+        body["slo_breached"] = breached
+    return (503 if breached else 200), body
+
+
+class ObsServer:
+    """One background HTTP server (live registry, or a static snapshot
+    dict when ``snapshot`` is given)."""
+
+    def __init__(self, port: int = 0, host: str = "127.0.0.1",
+                 snapshot: Optional[Dict[str, Any]] = None):
+        self._httpd = ThreadingHTTPServer((host, port), _Handler)
+        self._httpd.daemon_threads = True
+        self._httpd._static_snapshot = snapshot  # type: ignore[attr-defined]
+        self.host = host
+        self.port = self._httpd.server_address[1]
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    def start(self) -> "ObsServer":
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._httpd.serve_forever,
+                kwargs={"poll_interval": 0.25},
+                name="pyruhvro-obs", daemon=True,
+            )
+            self._thread.start()
+        return self
+
+    def serve_forever(self) -> None:
+        """Foreground mode (the CLI ``serve`` subcommand)."""
+        self._httpd.serve_forever(poll_interval=0.25)
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+
+
+def server() -> Optional[ObsServer]:
+    """The process's live obs server, if one is running."""
+    return _server
+
+
+def start(port: int = 0, host: str = "127.0.0.1") -> ObsServer:
+    """Start (or return) the process-wide live obs server. Idempotent:
+    a second start returns the running instance."""
+    global _server
+    with _lock:
+        if _server is None:
+            _server = ObsServer(port=port, host=host).start()
+            metrics.inc("obs.server_started")
+    return _server
+
+
+def stop() -> None:
+    global _server
+    with _lock:
+        srv, _server = _server, None
+    if srv is not None:
+        srv.stop()
+
+
+def start_from_env() -> Optional[ObsServer]:
+    """Start the server when ``PYRUHVRO_TPU_OBS_PORT`` is set (the
+    import-time hook in :mod:`.telemetry`). A malformed value or an
+    unbindable port is counted and logged, never raised — observability
+    must not take the service down."""
+    raw = os.environ.get("PYRUHVRO_TPU_OBS_PORT", "").strip()
+    if not raw:
+        return None
+    try:
+        import multiprocessing
+
+        if multiprocessing.parent_process() is not None:
+            # spawn-pool workers inherit the env: the PARENT owns the
+            # scrape endpoint (worker telemetry merges back into it);
+            # a worker binding the same fixed port would just fail
+            return None
+    except Exception:
+        pass
+    try:
+        port = int(raw)
+    except ValueError:
+        metrics.inc("obs.bad_port")
+        return None
+    try:
+        srv = start(port=port,
+                    host=os.environ.get("PYRUHVRO_TPU_OBS_HOST",
+                                        "127.0.0.1"))
+    except OSError:
+        metrics.inc("obs.bind_error")
+        return None
+    import sys
+
+    print(f"[pyruhvro_tpu] obs server listening on {srv.url} "
+          "(/metrics /healthz /snapshot /flight)", file=sys.stderr)
+    return srv

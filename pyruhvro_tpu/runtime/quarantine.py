@@ -149,4 +149,5 @@ def publish(entries: List[QuarantinedRecord], policy: str,
         metrics.inc(op + ".quarantined", float(len(entries) - merged))
     if len(entries) >= _storm_threshold():
         metrics.inc(op + ".quarantine_storms")
+        metrics.mark("quarantine_storm")  # the live /healthz bit
         telemetry._flight_autodump("quarantine")

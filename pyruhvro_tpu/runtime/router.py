@@ -39,7 +39,7 @@ import time
 from collections import deque
 from typing import Any, Dict, List, Optional, Tuple
 
-from . import costmodel, metrics, telemetry
+from . import costmodel, drift, metrics, sampling, telemetry
 
 __all__ = [
     "RouteDecision",
@@ -72,7 +72,8 @@ class RouteDecision:
 
     __slots__ = ("tier", "impl", "reason", "pool", "arm", "mode",
                  "explore", "autotune", "schema", "op", "band", "rows",
-                 "chunks", "predicted", "degraded", "_t0", "_done")
+                 "chunks", "predicted", "degraded", "sampled", "_t0",
+                 "_done")
 
     def __init__(self, *, tier, impl, reason, pool, arm, mode, explore,
                  autotune, schema, op, band, rows, chunks, predicted):
@@ -80,6 +81,10 @@ class RouteDecision:
         # decided arm (a process fan-out that degraded to threads): the
         # observation then must NOT teach the model that arm's cost
         self.degraded = False
+        # set True when this call ran the deep-sampled path (adaptive
+        # profiling): its wall seconds carry instrumentation overhead
+        # and are corrected before teaching the model
+        self.sampled = False
         self.tier = tier
         self.impl = impl
         self.reason = reason
@@ -172,6 +177,11 @@ def decide(entry, backend: str, n_rows: int, *, op: str, chunks: int,
                 for a in dropped:
                     del offered[a]
                 metrics.inc("router.storm_skip")
+        # latency drift (runtime/drift.py) needs no drop here: a
+        # drifted arm's predictions arrive INFLATED by the measured
+        # regression ratio (costmodel.predict x arm_penalty), so the
+        # greedy pick leaves it exactly when an alternative is
+        # predicted cheaper even against the inflated figure
         count = costmodel.tick(schema, op, band)
         rate = costmodel.explore_rate()
         period = int(round(1.0 / rate)) if rate > 0 else 0
@@ -211,6 +221,27 @@ def observe(dec: Optional[RouteDecision],
         return
     dec._done = True
     dt = time.perf_counter() - dec._t0
+    # a deep-sampled call's wall time includes the profiler's tax:
+    # divide the estimated overhead back out so the model learns the
+    # arm's TRUE cost (the ledger records the corrected figure too —
+    # it is the call's comparable cost). Only calls whose deep path
+    # ACTUALLY ran need (or may have) the correction — a sampled call
+    # with nothing to instrument executed at normal speed and teaches
+    # uncorrected. And until the sampler has measured the overhead at
+    # least once, a deep call is ledgered but teaches NOTHING: one
+    # uncorrected multi-second first deep call against a millisecond
+    # Welford mean would poison the arm's estimate for many calls.
+    ran_deep = dec.sampled and sampling.deep_ran()
+    uncorrectable = ran_deep and not sampling.overhead_known()
+    # tell the sampler which arm served this call: its overhead EWMAs
+    # key by the full routing feature (a deep/normal ratio learned on
+    # the native interpreter must not correct — or be tuned by — a
+    # device call). A degraded call's labeled arm did not run.
+    arm = None if dec.degraded else dec.arm
+    sampling.note_arm(arm)
+    if ran_deep and not uncorrectable:
+        dt = sampling.corrected_seconds(dt, dec.schema, dec.op,
+                                        dec.band, arm)
     metrics.inc("router.calls")
     if dec.explore:
         metrics.inc("router.explored")
@@ -219,10 +250,15 @@ def observe(dec: Optional[RouteDecision],
         # degradation): ledger it, but a mislabeled observation would
         # poison the model's estimate for the arm that did NOT run
         metrics.inc("router.degraded")
-    elif error is None:
+    elif error is None and not uncorrectable:
         costmodel.observe(dec.schema, dec.op, dec.band, dec.arm,
                           dec.rows, dt)
-    else:
+        if dec.rows > 0:
+            # the EWMA drift detector watches the same clean stream,
+            # keyed by the same (schema, op, band, arm) feature
+            drift.observe(dec.schema, dec.op, dec.band, dec.arm,
+                          dt / dec.rows)
+    elif error is not None:
         metrics.inc("router.call_error")
     pred = dec.predicted.get(dec.arm)
     entry: Dict[str, Any] = {
@@ -247,6 +283,8 @@ def observe(dec: Optional[RouteDecision],
     }
     if dec.degraded:
         entry["degraded"] = True
+    if dec.sampled:
+        entry["sampled"] = True
     if error is not None:
         entry["error"] = type(error).__name__
     with _lock:
@@ -373,6 +411,14 @@ def render_route_report(data: Dict[str, Any]) -> str:
     if pen:
         out += ["", "storm penalties (device arms withheld):"]
         out += [f"  {k}: {v:.1f}s remaining" for k, v in sorted(pen.items())]
+    apen = (r.get("model") or {}).get("arm_penalties") or {}
+    if apen:
+        out += ["", "drift penalties (predictions inflated):"]
+        out += [
+            f"  {k}: x{v.get('factor', 0):.2f} for "
+            f"{v.get('remaining_s', 0):.1f}s"
+            for k, v in sorted(apen.items()) if isinstance(v, dict)
+        ]
     return "\n".join(out) + "\n"
 
 

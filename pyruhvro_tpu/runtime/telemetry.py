@@ -51,6 +51,7 @@ from __future__ import annotations
 
 import json
 import os
+import re
 import sys
 import threading
 import time
@@ -58,7 +59,7 @@ from bisect import bisect_left
 from collections import deque
 from typing import Any, Dict, List, Optional
 
-from . import metrics
+from . import metrics, slo
 
 __all__ = [
     "SNAPSHOT_SCHEMA_VERSION",
@@ -278,6 +279,17 @@ class root_span:
                 _roots_seen += 1
         if self._prev is None:
             _maybe_trace(s)
+            # SLO accounting (runtime/slo.py): every finished API root
+            # call is one good/bad/errored event against any matching
+            # objective (~one dict lookup when no SLO file is set).
+            # Deep-sampled calls feed their COMPARABLE cost — the
+            # sampler's own profiling tax must not trip breaches
+            from . import sampling as _sampling
+
+            slo.record_root(
+                s.name, s.attrs.get("schema"),
+                _sampling.consume_last_correction(s.dur_s),
+                exc_type is not None)
             if exc_type is not None:
                 # a failed decode/encode leaves a replayable artifact
                 # when PYRUHVRO_TPU_FLIGHT_DIR points somewhere
@@ -453,11 +465,73 @@ def flight_dump(path: Optional[str] = None, *, blocking: bool = True):
     return path
 
 
+def _flight_max_files() -> int:
+    """Auto-dump retention cap (``PYRUHVRO_TPU_FLIGHT_MAX_FILES``,
+    default 32, 0 = unlimited): sustained storms must not grow the dump
+    directory without bound."""
+    return max(0, _env_int("PYRUHVRO_TPU_FLIGHT_MAX_FILES", 32))
+
+
+# rotation deletions observed from SIGNAL context defer their count
+# (metrics._lock is not reentrant and the handler may have interrupted
+# a frame inside it); flushed on the next snapshot/rotation
+_flight_dropped = metrics.DeferredCount("flight.dump_dropped")
+
+
+def _rotate_flight_dir(d: str, keep: int, counters: bool = True) -> int:
+    """Delete the oldest ``flight_*.json`` dumps past ``keep`` files;
+    each deletion counts ``flight.dump_dropped``. Only auto-dump-shaped
+    names are touched — operator-written files are never rotated.
+    ``counters=False`` is the signal-handler path: deletions are
+    deferred to the ``_flight_dropped`` tally instead of taking the
+    metrics lock (which the interrupted frame may hold). Returns the
+    number dropped; never raises (best-effort cleanup)."""
+    if counters:
+        # flush BEFORE the early returns below: deletions deferred from
+        # signal context must not wait for the next over-limit rotation
+        _flight_dropped.flush()
+    if keep <= 0:
+        return 0
+    try:
+        # only the exact auto-dump shape flight_<pid>_<seq>_<tag>.json:
+        # an operator's hand-saved flight_incident.json must survive
+        files = [
+            os.path.join(d, f) for f in os.listdir(d)
+            if re.fullmatch(r"flight_\d+_\d+_\w+\.json", f)
+        ]
+    except OSError:
+        return 0
+    if len(files) <= keep:
+        return 0
+
+    def mtime(p: str) -> float:
+        try:
+            return os.path.getmtime(p)
+        except OSError:
+            return 0.0
+
+    files.sort(key=mtime)
+    dropped = 0
+    for p in files[: len(files) - keep]:
+        try:
+            os.remove(p)
+            dropped += 1
+        except OSError:
+            continue
+    if not counters:
+        _flight_dropped.bump(dropped)  # signal side: increment only
+    elif dropped:
+        metrics.inc("flight.dump_dropped", float(dropped))
+    return dropped
+
+
 def _flight_autodump(tag: str, blocking: bool = True) -> Optional[str]:
     """Write a flight dump into PYRUHVRO_TPU_FLIGHT_DIR (no-op when
     unset); rate-limited to one per second so an error storm cannot
-    flood the disk, and never allowed to fail the call it observes.
-    ``blocking=False`` from signal context (see _flight_records)."""
+    flood the disk, rotated to PYRUHVRO_TPU_FLIGHT_MAX_FILES retained
+    dumps so a long-running storm cannot fill it either, and never
+    allowed to fail the call it observes. ``blocking=False`` from
+    signal context (see _flight_records)."""
     global _flight_seq, _flight_last_auto
     d = os.environ.get("PYRUHVRO_TPU_FLIGHT_DIR")
     if not d:
@@ -469,9 +543,11 @@ def _flight_autodump(tag: str, blocking: bool = True) -> Optional[str]:
     _flight_seq += 1
     path = os.path.join(d, f"flight_{os.getpid()}_{_flight_seq}_{tag}.json")
     try:
-        return flight_dump(path, blocking=blocking)
+        out = flight_dump(path, blocking=blocking)
     except (OSError, ValueError):
         return None
+    _rotate_flight_dir(d, _flight_max_files(), counters=blocking)
+    return out
 
 
 def install_flight_signal() -> bool:
@@ -503,9 +579,24 @@ def install_flight_signal() -> bool:
 
 
 # operators who configure a dump directory get the SIGUSR1 hook without
-# any code change; everyone else pays nothing (no handler installed)
-if os.environ.get("PYRUHVRO_TPU_FLIGHT_DIR"):
-    install_flight_signal()
+# any code change; everyone else pays nothing (no handler installed).
+# SIGUSR2 (toggle deep sampling live) rides the same opt-in, plus the
+# obs-server one — both are incident-time controls.
+if (os.environ.get("PYRUHVRO_TPU_FLIGHT_DIR")
+        or os.environ.get("PYRUHVRO_TPU_OBS_PORT")):
+    if os.environ.get("PYRUHVRO_TPU_FLIGHT_DIR"):
+        install_flight_signal()
+    from . import sampling as _sampling
+
+    _sampling.install_toggle_signal()
+
+# the live observability plane (runtime/obs_server.py): opt-in via
+# PYRUHVRO_TPU_OBS_PORT, started once at import so a service gets
+# /metrics + /healthz without any code change
+if os.environ.get("PYRUHVRO_TPU_OBS_PORT"):
+    from . import obs_server as _obs_server
+
+    _obs_server.start_from_env()
 
 
 # ---------------------------------------------------------------------------
@@ -640,10 +731,14 @@ def reset() -> None:
         _flight.clear()
         _roots_seen = 0
         _flight_last_auto = 0.0  # re-arm the auto-dump rate limiter
-    from . import device_obs, router
+    _flight_dropped.reset()
+    from . import device_obs, drift, router, sampling
 
     device_obs.reset()
     router.reset()
+    sampling.reset()
+    drift.reset()
+    slo.reset()
     with _trace_lock:
         if _trace_memo is not None:
             fh = _trace_memo[1]
@@ -673,6 +768,9 @@ def snapshot() -> Dict[str, Any]:
     shape-compatible with older consumers; ``schema_version`` stamps the
     document shape (absent = pre-PR-6 legacy, still rendered by every
     CLI)."""
+    # rotation drops deferred from signal context surface on the next
+    # export even if no further rotation ever runs
+    _flight_dropped.flush()
     with _lock:
         hists = {k: h.summary() for k, h in sorted(_hists.items())}
         spans = [s.to_dict() for s in _spans]
@@ -687,7 +785,7 @@ def snapshot() -> Dict[str, Any]:
         "spans_dropped": dropped,
         "flight_records": flight_n,
     }
-    from . import device_obs, router
+    from . import device_obs, drift, router, sampling
 
     dev = device_obs.snapshot()
     if dev:
@@ -695,6 +793,17 @@ def snapshot() -> Dict[str, Any]:
     routing = router.snapshot_routing()
     if routing:
         out["routing"] = routing
+    # live-observability sections (ISSUE 7) — all omitted when their
+    # subsystem never ran, so snapshots stay shape-compatible
+    slo_sec = slo.snapshot_slo()
+    if slo_sec:
+        out["slo"] = slo_sec
+    samp = sampling.snapshot_sampling()
+    if samp:
+        out["sampling"] = samp
+    dr = drift.snapshot_drift()
+    if dr:
+        out["drift"] = dr
     return out
 
 
@@ -1068,6 +1177,17 @@ def render_report(data: Dict[str, Any]) -> str:
                 f"(enabled {ov.get('enabled_s', 0) * 1e3:.3f} ms, "
                 f"disabled {ov.get('disabled_s', 0) * 1e3:.3f} ms)"
             )
+        sov = data.get("sampling_overhead")
+        if sov:
+            out.append(
+                f"adaptive-sampling overhead on "
+                f"{sov.get('workload', '?')}: "
+                f"{sov.get('overhead_frac', 0) * 100:.2f}% vs budget "
+                f"{(sov.get('budget') or 0) * 100:.2f}% "
+                f"(period {sov.get('period')}, "
+                f"{sov.get('deep_calls')} deep call(s)) -> "
+                f"{'ok' if sov.get('within_budget') else 'OVER BUDGET'}"
+            )
     else:  # telemetry snapshot
         counters = data.get("counters", {})
         hists = data.get("histograms", {})
@@ -1104,6 +1224,36 @@ def render_report(data: Dict[str, Any]) -> str:
                 f"{'y' if len(routing['ledger']) == 1 else 'ies'} "
                 f"(autotune {'on' if routing.get('autotune') else 'off'}"
                 ") — render with the route-report / what-if subcommands")
+        slo_sec = data.get("slo") or {}
+        if slo_sec:
+            breached = slo_sec.get("breached") or []
+            out += ["", "== slo =="]
+            out.append(
+                f"{len(slo_sec.get('objectives') or [])} objective(s); "
+                f"breached: {', '.join(breached) or 'none'} — render "
+                "with the slo-report subcommand")
+        samp = data.get("sampling") or {}
+        if samp:
+            out += ["", "== adaptive deep sampling =="]
+            out.append(
+                f"deep {samp.get('deep_calls', 0)}/"
+                f"{samp.get('calls', 0)} call(s), period "
+                f"{samp.get('period')}, est. deep overhead "
+                f"{(samp.get('overhead_frac') or 0) * 100:.2f}% per "
+                f"sampled call (budget "
+                f"{(samp.get('budget') or 0) * 100:.2f}% of total)")
+        dr = data.get("drift") or {}
+        if dr.get("entries"):
+            hot = [e for e in dr["entries"] if e.get("detections")]
+            out += ["", "== latency drift =="]
+            out.append(f"{len(dr['entries'])} (schema, arm) pair(s) "
+                       f"tracked; {len(hot)} with detections")
+            for e in hot[:8]:
+                out.append(
+                    f"  {e.get('schema')} {e.get('op', '?')} "
+                    f"band={e.get('band', '?')} {e.get('arm')}: "
+                    f"{e.get('detections')} detection(s), "
+                    f"fast/slow={e.get('ratio')}")
         other = {k: v for k, v in counters.items()
                  if not k.endswith("_s")
                  and not k.startswith(("route.", "router."))
@@ -1128,8 +1278,10 @@ def main(argv: Optional[List[str]] = None) -> int:
     exposition) / ``perfetto <file> [-o out.json]`` (Chrome/Perfetto
     trace-event timeline) / ``route-report <file>`` (routing ledger +
     learned cost model) / ``what-if <file>`` (ledger replay: where a
-    different arm would have won). ``<file>`` is a saved
-    :func:`snapshot` JSON or, for ``report``, a ``BENCH_DETAILS.json``."""
+    different arm would have won) / ``slo-report <file>`` (objectives,
+    burn rates, breach state) / ``serve <file> [--port N]`` (serve a
+    saved snapshot over HTTP). ``<file>`` is a saved :func:`snapshot`
+    JSON or, for ``report``, a ``BENCH_DETAILS.json``."""
     import argparse
 
     ap = argparse.ArgumentParser(
@@ -1158,6 +1310,19 @@ def main(argv: Optional[List[str]] = None) -> int:
         "what-if", help="replay a snapshot's routing ledger: where "
                         "would a different arm have won?")
     p_whatif.add_argument("path")
+    p_slo = sub.add_parser(
+        "slo-report", help="SLO objectives, burn rates and breach "
+                           "state from a snapshot JSON")
+    p_slo.add_argument("path")
+    p_serve = sub.add_parser(
+        "serve", help="serve a SAVED snapshot over HTTP (/metrics "
+                      "/healthz /snapshot) — point dashboards at a "
+                      "post-mortem file; live services use "
+                      "PYRUHVRO_TPU_OBS_PORT instead")
+    p_serve.add_argument("path")
+    p_serve.add_argument("--port", type=int, default=0,
+                         help="bind port (default 0 = any free port)")
+    p_serve.add_argument("--host", default="127.0.0.1")
     args = ap.parse_args(argv)
 
     def _usage_error(msg: str) -> int:
@@ -1199,6 +1364,30 @@ def main(argv: Optional[List[str]] = None) -> int:
         render = (router.render_route_report if args.cmd == "route-report"
                   else router.render_what_if)
         sys.stdout.write(render(data))
+    elif args.cmd == "slo-report":
+        if not ({"slo", "counters", "histograms"} & set(data)):
+            return _usage_error(
+                "not a telemetry snapshot (expected 'slo'/'counters'/"
+                "'histograms' keys)")
+        sys.stdout.write(slo.render_slo_report(data))
+    elif args.cmd == "serve":
+        if not ({"counters", "histograms", "spans"} & set(data)):
+            return _usage_error(
+                "not a telemetry snapshot (expected 'counters'/"
+                "'histograms'/'spans' keys)")
+        from . import obs_server
+
+        srv = obs_server.ObsServer(port=args.port, host=args.host,
+                                   snapshot=data)
+        print(f"serving {args.path} on {srv.url} "
+              "(/metrics /healthz /snapshot) — Ctrl-C to stop",
+              file=sys.stderr, flush=True)
+        try:
+            srv.serve_forever()
+        except KeyboardInterrupt:
+            pass
+        finally:
+            srv.stop()
     elif args.cmd == "report":
         if not ({"results", "counters", "histograms"} & set(data)):
             return _usage_error(

@@ -502,7 +502,18 @@ def main(argv: Optional[list] = None) -> int:
     ap.add_argument("--route-tolerance", type=float,
                     default=float(os.environ.get(
                         "PYRUHVRO_TPU_ROUTE_TOLERANCE", 0.05)))
+    ap.add_argument("--slo-file",
+                    default=os.environ.get("PYRUHVRO_TPU_SLO_FILE"),
+                    help="evaluate this SLO file over the gate run: the "
+                         "saved snapshot gains an 'slo' section (burn "
+                         "rates, breach state) the slo-report CLI "
+                         "renders — CI uploads it as an artifact")
     args = ap.parse_args(argv)
+
+    if args.slo_file:
+        # must be set before the library records any root span so every
+        # measured call feeds the burn windows
+        os.environ["PYRUHVRO_TPU_SLO_FILE"] = args.slo_file
 
     if args.route_matrix:
         return route_matrix(args)
@@ -539,6 +550,22 @@ def main(argv: Optional[list] = None) -> int:
             _log(f"[perf-gate] calibration {calib * 1e3:.1f} ms "
                  "(no baseline calibration; raw comparison)")
         fresh = measure_cases(args.rows, args.chunks, args.reps)
+        if args.slo_file:
+            from pyruhvro_tpu.runtime import slo as _slo
+
+            sec = _slo.snapshot_slo()
+            hot = sec.get("breached") or []
+            fired = sum(int(o.get("breaches") or 0)
+                        for o in sec.get("objectives") or [])
+            if hot:
+                msg = f"CURRENTLY BREACHED: {', '.join(hot)}"
+            elif fired:
+                # a breach that fired and time-decayed mid-run still
+                # happened — the instantaneous state alone would lie
+                msg = f"{fired} breach(es) fired during the run (recovered)"
+            else:
+                msg = "no objective breached over the gate run"
+            _log(f"[perf-gate] slo ({args.slo_file}): {msg}")
         if args.snapshot_out:
             try:
                 save_snapshot(args.snapshot_out)

@@ -1,0 +1,727 @@
+"""Live observability plane (ISSUE 7): in-process scrape/health HTTP
+endpoints, the SLO burn-rate engine, always-on adaptive deep sampling,
+the EWMA latency-drift detector, flight-dir rotation, the SIGUSR2
+sampling toggle, and the new ``serve``/``slo-report`` CLI subcommands
+(plus the existing CLIs over schema_version-2 snapshots that carry the
+new ``slo``/``drift``/``sampling`` sections).
+
+Everything binds to 127.0.0.1 with port 0 (the OS picks a free port) —
+no fixed ports, no network flakiness. Host-tier only.
+"""
+
+import json
+import os
+import signal
+import sys
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from pyruhvro_tpu import deserialize_array, serialize_record_batch, telemetry
+from pyruhvro_tpu.runtime import (
+    costmodel,
+    drift,
+    metrics,
+    obs_server,
+    sampling,
+    slo,
+)
+from pyruhvro_tpu.utils.datagen import KAFKA_SCHEMA_JSON, kafka_style_datums
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+LEGACY_SNAPSHOT = os.path.join(
+    REPO, "tests", "data", "telemetry_snapshot_sample.json")
+
+
+def _get(url):
+    """GET -> (status, body_bytes); HTTP errors return their status."""
+    try:
+        with urllib.request.urlopen(url, timeout=10) as r:
+            return r.status, r.read()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read()
+
+
+@pytest.fixture
+def srv():
+    server = obs_server.ObsServer(port=0).start()
+    yield server
+    server.stop()
+
+
+def _slo_file(tmp_path, **over):
+    obj = {
+        "name": "t-decode", "op": "decode", "schema": "*",
+        "threshold_s": 1e-9, "target": 0.5, "windows_s": [1, 5],
+        "burn_threshold": 1.5, "min_calls": 5,
+    }
+    obj.update(over)
+    path = tmp_path / "slo.json"
+    path.write_text(json.dumps({"version": 1, "objectives": [obj]}))
+    return str(path)
+
+
+# ---------------------------------------------------------------------------
+# obs server endpoints
+# ---------------------------------------------------------------------------
+
+
+def test_metrics_scrape_byte_identical_to_exporter(srv):
+    """Acceptance: the live /metrics scrape is byte-compatible with the
+    existing Prometheus exporter on the same registry state."""
+    data = kafka_style_datums(100, seed=3)
+    deserialize_array(data, KAFKA_SCHEMA_JSON, backend="host")
+    code, body = _get(srv.url + "/metrics")
+    assert code == 200
+    assert body.decode() == telemetry.prometheus()
+    assert b"pyruhvro_tpu_api_deserialize_array_seconds" in body
+
+
+def test_snapshot_and_flight_endpoints(srv):
+    data = kafka_style_datums(50, seed=3)
+    deserialize_array(data, KAFKA_SCHEMA_JSON, backend="host")
+    code, body = _get(srv.url + "/snapshot")
+    assert code == 200
+    snap = json.loads(body)
+    assert snap["schema_version"] == telemetry.SNAPSHOT_SCHEMA_VERSION
+    assert snap["counters"] and snap["spans"]
+    code, body = _get(srv.url + "/flight")
+    assert code == 200
+    doc = json.loads(body)
+    assert doc["pid"] == os.getpid()
+    assert len(doc["records"]) == 1
+
+
+def test_unknown_path_404(srv):
+    code, body = _get(srv.url + "/nope")
+    assert code == 404
+    assert "/metrics" in json.loads(body)["endpoints"]
+
+
+def test_healthz_ok_then_quarantine_storm_flips_503(srv, monkeypatch):
+    """Acceptance: /healthz returns non-200 during an induced
+    quarantine storm, and recovers once the health window passes."""
+    monkeypatch.setenv("PYRUHVRO_TPU_QUARANTINE_STORM", "5")
+    code, body = _get(srv.url + "/healthz")
+    assert code == 200
+    doc = json.loads(body)
+    assert doc["ready"] is True and doc["status"] in ("ok", "degraded")
+    bad = [d[:2] for d in kafka_style_datums(10, seed=3)]
+    deserialize_array(bad, KAFKA_SCHEMA_JSON, backend="host",
+                      on_error="skip")
+    code, body = _get(srv.url + "/healthz")
+    assert code == 503
+    doc = json.loads(body)
+    assert doc["unhealthy_bits"]["quarantine_storm"] is True
+    assert doc["status"] == "unhealthy"
+    # the storm ages out of the (shrunken) health window -> green again
+    monkeypatch.setenv("PYRUHVRO_TPU_HEALTH_WINDOW", "0")
+    time.sleep(0.01)
+    code, _ = _get(srv.url + "/healthz")
+    assert code == 200
+
+
+def test_healthz_flips_on_recompile_storm_and_drift_marks(srv):
+    metrics.mark("recompile_storm")
+    code, body = _get(srv.url + "/healthz")
+    assert code == 503
+    assert json.loads(body)["unhealthy_bits"]["recompile_storm"] is True
+    telemetry.reset()  # clears marks
+    metrics.mark("latency_drift")
+    code, body = _get(srv.url + "/healthz")
+    assert code == 503
+    assert json.loads(body)["unhealthy_bits"]["latency_drift"] is True
+    telemetry.reset()
+    code, _ = _get(srv.url + "/healthz")
+    assert code == 200
+
+
+def test_handler_survives_errors(srv, monkeypatch):
+    """A broken exporter must 500 the request, never kill the server."""
+    monkeypatch.setattr(telemetry, "prometheus",
+                        lambda snap=None: 1 / 0)
+    code, _ = _get(srv.url + "/metrics")
+    assert code == 500
+    assert metrics.snapshot().get("obs.handler_error", 0) >= 1
+    monkeypatch.undo()
+    code, _ = _get(srv.url + "/metrics")  # still serving
+    assert code == 200
+
+
+def test_module_level_start_idempotent_and_from_env(monkeypatch):
+    try:
+        a = obs_server.start(port=0)
+        b = obs_server.start(port=12345)  # ignored: already running
+        assert a is b
+        monkeypatch.setenv("PYRUHVRO_TPU_OBS_PORT", "0")
+        assert obs_server.start_from_env() is a
+    finally:
+        obs_server.stop()
+    assert obs_server.server() is None
+
+
+def test_static_snapshot_server_modes():
+    """The same server class serves a SAVED snapshot (the CLI `serve`
+    path): /metrics renders the file, /healthz reports recorded state —
+    503 when the file recorded an active SLO breach."""
+    snap = {
+        "schema_version": 2, "pid": 1234,
+        "counters": {"decode.calls": 3.0, "host.vm_s": 0.5},
+        "histograms": {}, "spans": [],
+        "slo": {"breached": ["x"], "objectives": []},
+    }
+    server = obs_server.ObsServer(port=0, snapshot=snap).start()
+    try:
+        code, body = _get(server.url + "/metrics")
+        assert code == 200
+        assert body.decode() == telemetry.prometheus(snap)
+        code, body = _get(server.url + "/healthz")
+        assert code == 503
+        assert json.loads(body)["slo_breached"] == ["x"]
+        code, body = _get(server.url + "/snapshot")
+        assert json.loads(body)["pid"] == 1234
+    finally:
+        server.stop()
+    snap["slo"]["breached"] = []
+    server = obs_server.ObsServer(port=0, snapshot=snap).start()
+    try:
+        code, body = _get(server.url + "/healthz")
+        assert code == 200
+        assert json.loads(body)["static"] is True
+    finally:
+        server.stop()
+
+
+# ---------------------------------------------------------------------------
+# SLO burn-rate engine
+# ---------------------------------------------------------------------------
+
+
+def test_slo_breach_counters_and_healthz(tmp_path, monkeypatch, srv):
+    monkeypatch.setenv("PYRUHVRO_TPU_SLO_FILE", _slo_file(tmp_path))
+    data = kafka_style_datums(50, seed=5)
+    for _ in range(8):
+        deserialize_array(data, KAFKA_SCHEMA_JSON, backend="host")
+    assert slo.breached() == ["t-decode"]
+    c = metrics.snapshot()
+    assert c.get("slo.breach") == 1.0
+    assert c.get("slo.breach.t-decode") == 1.0
+    assert c.get("slo.calls", 0) >= 8
+    code, body = _get(srv.url + "/healthz")
+    assert code == 503
+    assert json.loads(body)["slo_breached"] == ["t-decode"]
+    snap = telemetry.snapshot()
+    obj = snap["slo"]["objectives"][0]
+    assert obj["breached"] is True
+    assert all(w["burn_rate"] >= 1.5 for w in obj["windows"])
+
+
+def test_slo_burn_rate_math_and_recovery():
+    """Unit-level burn math: target 0.9 -> budget 0.1; 2 bad of 10 in
+    the window = bad_frac 0.2 = burn 2.0. Multi-window: the long window
+    must ALSO burn before a breach fires; recovery clears on the short
+    window."""
+    o = slo._Objective({
+        "name": "u", "op": "decode", "threshold_s": 1.0, "target": 0.9,
+        "windows_s": [5, 50], "burn_threshold": 1.9, "min_calls": 10,
+    }, 0)
+    now = 1000.0
+    for i in range(8):
+        o.add(now + i * 0.1, 0.1, False)   # good
+    for i in range(2):
+        o.add(now + 1 + i * 0.1, 5.0, False)  # bad (over threshold)
+    stats = o.window_stats(now + 2)
+    assert stats[0]["total"] == 10 and stats[0]["bad"] == 2
+    assert stats[0]["burn_rate"] == pytest.approx(2.0, abs=1e-6)
+    assert o.evaluate(now + 2) is True and o.breached
+    # a flood of good calls pulls the short window back under
+    for i in range(200):
+        o.add(now + 2.5 + i * 0.01, 0.1, False)
+    assert o.evaluate(now + 4.6) is False
+    assert not o.breached
+
+
+def test_slo_breach_recovers_without_traffic(tmp_path, monkeypatch, srv):
+    """A breach must clear by TIME DECAY alone: once /healthz goes 503
+    a load balancer drains the traffic, so recovery cannot depend on
+    new matching calls arriving (readiness-probe death spiral)."""
+    monkeypatch.setenv("PYRUHVRO_TPU_SLO_FILE", _slo_file(
+        tmp_path, windows_s=[0.4, 0.8]))
+    data = kafka_style_datums(30, seed=5)
+    for _ in range(8):
+        deserialize_array(data, KAFKA_SCHEMA_JSON, backend="host")
+    assert slo.breached() == ["t-decode"]
+    code, _ = _get(srv.url + "/healthz")
+    assert code == 503
+    time.sleep(1.0)  # everything ages out of the short window; NO calls
+    assert slo.breached() == []
+    assert metrics.snapshot().get("slo.recovered") == 1.0
+    code, _ = _get(srv.url + "/healthz")
+    assert code == 200
+
+
+def test_slo_error_target_counts_errors(tmp_path, monkeypatch):
+    monkeypatch.setenv("PYRUHVRO_TPU_SLO_FILE", _slo_file(
+        tmp_path, threshold_s=None, target=0.999, error_target=0.01,
+        burn_threshold=1.0, min_calls=3))
+    data = kafka_style_datums(10, seed=5)
+    bad = [d[:2] for d in data]
+    for _ in range(4):
+        with pytest.raises(Exception):
+            deserialize_array(bad, KAFKA_SCHEMA_JSON, backend="host")
+    assert metrics.snapshot().get("slo.errors", 0) >= 4
+    assert slo.breached() == ["t-decode"]
+
+
+def test_slo_breach_autodumps_flight_and_fires_alert(tmp_path,
+                                                    monkeypatch):
+    flag = tmp_path / "alert_fired"
+    monkeypatch.setenv("PYRUHVRO_TPU_FLIGHT_DIR", str(tmp_path))
+    monkeypatch.setenv("PYRUHVRO_TPU_SLO_FILE", _slo_file(
+        tmp_path,
+        alert_command=f"{sys.executable} -c "
+                      f"\"open(r'{flag}', 'w').write('x')\""))
+    data = kafka_style_datums(30, seed=5)
+    for _ in range(8):
+        deserialize_array(data, KAFKA_SCHEMA_JSON, backend="host")
+    assert slo.breached()
+    dumps = [f for f in os.listdir(tmp_path)
+             if f.startswith("flight_") and f.endswith("slo_breach.json")]
+    assert len(dumps) == 1
+    assert metrics.snapshot().get("slo.alert_fired") == 1.0
+    for _ in range(100):  # the hook runs detached; give it a moment
+        if flag.exists():
+            break
+        time.sleep(0.05)
+    assert flag.exists()
+
+
+def test_slo_missing_or_corrupt_config_is_inactive(tmp_path,
+                                                   monkeypatch):
+    monkeypatch.setenv("PYRUHVRO_TPU_SLO_FILE",
+                       str(tmp_path / "missing.json"))
+    assert not slo.active()
+    assert slo.breached() == []
+    assert metrics.snapshot().get("slo.config_error") == 1.0
+    assert telemetry.snapshot()["slo"]["config_error"]
+    bad = tmp_path / "bad.json"
+    bad.write_text("{not json")
+    monkeypatch.setenv("PYRUHVRO_TPU_SLO_FILE", str(bad))
+    assert not slo.active()
+    # calls keep working with a broken SLO config
+    deserialize_array(kafka_style_datums(5, seed=5),
+                      KAFKA_SCHEMA_JSON, backend="host")
+
+
+def test_slo_schema_and_op_matching(tmp_path, monkeypatch):
+    monkeypatch.setenv("PYRUHVRO_TPU_SLO_FILE", _slo_file(
+        tmp_path, op="encode"))
+    data = kafka_style_datums(20, seed=5)
+    for _ in range(8):
+        deserialize_array(data, KAFKA_SCHEMA_JSON, backend="host")
+    assert slo.breached() == []  # decode calls never match an encode SLO
+    batch = deserialize_array(data, KAFKA_SCHEMA_JSON, backend="host")
+    for _ in range(8):
+        serialize_record_batch(batch, KAFKA_SCHEMA_JSON, 1,
+                               backend="host")
+    assert slo.breached() == ["t-decode"]
+
+
+# ---------------------------------------------------------------------------
+# adaptive deep sampling
+# ---------------------------------------------------------------------------
+
+
+def _native_ok():
+    try:
+        from pyruhvro_tpu.hostpath import native_available
+
+        return native_available()
+    except Exception:
+        return False
+
+
+def test_sampling_deep_calls_and_budget_tuning():
+    """Acceptance core: with the sampler on, ~1/period calls run the
+    deep path, vm.op.* sampled coverage appears weight-corrected in the
+    live snapshot (native tier), and the period retunes from the
+    measured overhead so rate x overhead stays under budget."""
+    if not _native_ok():
+        pytest.skip("no C++ toolchain")
+    # the prof module loads on a background thread (a cold g++ build
+    # must never stall a live call); wait for it here so the deep calls
+    # below actually run instrumented
+    sampling.prof_codec_module()
+    if sampling._prof_thread is not None:
+        sampling._prof_thread.join(timeout=180)
+    if sampling.prof_codec_module() is None:
+        pytest.skip("profiled VM build unavailable")
+    data = kafka_style_datums(300, seed=9)
+    sampling.set_enabled(True)
+    try:
+        for _ in range(sampling._PERIOD_START * 2):
+            deserialize_array(data, KAFKA_SCHEMA_JSON, backend="host")
+    finally:
+        sampling.set_enabled(None)
+    snap = telemetry.snapshot()
+    samp = snap["sampling"]
+    assert samp["deep_calls"] >= 1
+    assert samp["calls"] >= sampling._PERIOD_START * 2
+    c = snap["counters"]
+    assert any(k.startswith("vm.op.") and k.endswith("_s") for k in c), (
+        sorted(k for k in c if k.startswith("vm")))
+    assert c.get("sampling.deep_calls") == samp["deep_calls"]
+    # budget math: period >= overhead/budget (within rounding + floor)
+    if samp["overhead_frac"] > 0:
+        want = samp["overhead_frac"] / samp["budget"]
+        assert samp["period"] >= min(
+            sampling._PERIOD_MAX, max(sampling._PERIOD_MIN,
+                                      round(want))) - 1
+    ledger = snap["routing"]["ledger"]
+    assert any(e.get("sampled") for e in ledger)
+
+
+def test_sampling_disabled_states(monkeypatch):
+    monkeypatch.setenv("PYRUHVRO_TPU_SAMPLE_BUDGET", "0")
+    assert not sampling.enabled()
+    monkeypatch.setenv("PYRUHVRO_TPU_SAMPLE_BUDGET", "0.02")
+    assert sampling.enabled()
+    assert sampling.budget() == 0.02
+    telemetry.set_enabled(False)
+    try:
+        assert not sampling.enabled()  # telemetry off -> sampler off
+    finally:
+        telemetry.set_enabled(True)
+    sampling.set_enabled(False)  # explicit override wins over env
+    assert not sampling.enabled()
+    data = kafka_style_datums(10, seed=9)
+    deserialize_array(data, KAFKA_SCHEMA_JSON, backend="host")
+    assert "sampling.calls" not in metrics.snapshot()
+    sampling.set_enabled(None)
+
+
+def test_sampling_toggle_and_corrected_seconds():
+    start = sampling.enabled()
+    assert sampling.toggle() == (not start)
+    assert sampling.toggle() == start
+    assert metrics.snapshot().get("sampling.toggled") == 2.0
+    # correction divides the estimated overhead back out
+    sampling._overhead = 1.0
+    try:
+        assert sampling.corrected_seconds(2.0) == pytest.approx(1.0)
+    finally:
+        sampling._overhead = 0.0
+
+
+def test_sampling_correction_is_per_arm():
+    """The deep/normal overhead ratio is only comparable within one
+    arm: a ~4x interpreter tax measured on the native tier must not
+    correct (and so under-teach) a deep-sampled DEVICE call — the
+    routing cost model would learn the device arm ~4x cheaper than it
+    is. Same-arm features use their own ratio, sibling arms on the
+    same tier share a mean, and a wholly unmeasured tier gets no
+    correction at all."""
+    sampling.reset()
+    native = ("fp", "decode", 14, "native/c4/thread")
+    with sampling._lock:
+        # native pair measured: deep costs 4x normal
+        sampling._feat[native] = [1e-6, 4e-6, 8.0, 8.0]
+        sampling._retune()
+    assert sampling.overhead_known()
+    # same feature + arm: the measured 4x divides out
+    assert sampling.corrected_seconds(4.0, *native) == pytest.approx(1.0)
+    # sibling arm, same tier, unmeasured: the tier mean (still ~4x)
+    assert sampling.corrected_seconds(
+        4.0, "fp", "decode", 14, "native/c8/thread") == pytest.approx(1.0)
+    # DIFFERENT tier, wholly unmeasured: no correction — never the
+    # native interpreter's ratio
+    assert sampling.corrected_seconds(
+        4.0, "fp", "decode", 14, "device/c1/none") == pytest.approx(4.0)
+    # once the device pair IS measured, its own (mild) ratio applies
+    device = ("fp", "decode", 14, "device/c1/none")
+    with sampling._lock:
+        sampling._feat[device] = [1e-6, 1.1e-6, 4.0, 4.0]
+    assert sampling.corrected_seconds(4.0, *device) == pytest.approx(
+        4.0 / 1.1)
+    sampling.reset()
+
+
+@pytest.mark.skipif(not hasattr(signal, "SIGUSR2"), reason="no SIGUSR2")
+def test_sigusr2_toggles_sampling():
+    assert sampling.install_toggle_signal()
+    before = sampling.enabled()
+    os.kill(os.getpid(), signal.SIGUSR2)
+    time.sleep(0.05)
+    assert sampling.enabled() == (not before)
+    os.kill(os.getpid(), signal.SIGUSR2)
+    time.sleep(0.05)
+    assert sampling.enabled() == before
+
+
+def test_sampling_deep_flag_is_per_thread():
+    sampling.set_enabled(True)
+    sampling._period = 1  # every call samples (reset restores the start)
+    try:
+        with sampling.call_scope("decode", "fp", 10) as smp:
+            import threading
+
+            assert smp.sampled and sampling.deep_active()
+            seen = []
+            t = threading.Thread(
+                target=lambda: seen.append(sampling.deep_active()))
+            t.start()
+            t.join()
+            assert seen == [False]  # instrumentation never leaks across
+        assert not sampling.deep_active()
+    finally:
+        sampling.set_enabled(None)
+
+
+# ---------------------------------------------------------------------------
+# latency-drift detector
+# ---------------------------------------------------------------------------
+
+
+def test_drift_detection_penalizes_arm(tmp_path, monkeypatch):
+    monkeypatch.setenv("PYRUHVRO_TPU_FLIGHT_DIR", str(tmp_path))
+    arm = "native/c8/thread"
+    for _ in range(20):
+        drift.observe("fpD", "decode", 11, arm, 1e-6)
+    assert metrics.snapshot().get("drift.detected", 0) == 0
+    for _ in range(10):
+        drift.observe("fpD", "decode", 11, arm, 2.5e-6)  # sustained 2.5x regression
+    c = metrics.snapshot()
+    assert c.get("drift.detected") == 1.0
+    assert c.get("router.arm_penalty") == 1.0
+    assert costmodel.arm_penalized("fpD", arm)
+    assert not costmodel.device_penalized("fpD")  # host arm: arm-only
+    assert metrics.mark_age("latency_drift") is not None
+    assert any(f.endswith("drift.json") for f in os.listdir(tmp_path))
+    entries = telemetry.snapshot()["drift"]["entries"]
+    assert entries[0]["detections"] == 1
+    # post-detection the new regime is the baseline: steady-state at the
+    # new level does not re-fire
+    for _ in range(20):
+        drift.observe("fpD", "decode", 11, arm, 2.5e-6)
+    assert metrics.snapshot().get("drift.detected") == 1.0
+
+
+def test_drift_on_device_arm_penalizes_device_tier():
+    for _ in range(20):
+        drift.observe("fpE", "decode", 11, "device/c1/none", 1e-6)
+    for _ in range(10):
+        drift.observe("fpE", "decode", 11, "device/c1/none", 3e-6)
+    assert costmodel.device_penalized("fpE")
+    assert costmodel.arm_penalized("fpE", "device/c1/none")
+
+
+def test_drift_single_spike_does_not_fire():
+    for _ in range(20):
+        drift.observe("fpF", "decode", 11, "native/c1/none", 1e-6)
+    drift.observe("fpF", "decode", 11, "native/c1/none", 5e-6)  # one GC pause
+    for _ in range(10):
+        drift.observe("fpF", "decode", 11, "native/c1/none", 1e-6)
+    assert metrics.snapshot().get("drift.detected", 0) == 0
+
+
+def test_drift_penalty_inflates_predictions_softly(monkeypatch):
+    """A drift penalty INFLATES the arm's predictions by the measured
+    factor — the router re-routes only when an alternative is
+    predicted cheaper even against the inflated figure (a hard
+    withhold would force a 1.6x-drifted arm onto a 4x-worse one, the
+    route-matrix failure mode)."""
+    from pyruhvro_tpu.runtime import router
+    from pyruhvro_tpu.schema.cache import get_or_parse_schema
+
+    monkeypatch.setenv("PYRUHVRO_TPU_AUTOTUNE", "1")
+    monkeypatch.setenv("PYRUHVRO_TPU_EXPLORE", "0")
+    monkeypatch.setenv("PYRUHVRO_TPU_ROUTING_PROFILE", "")
+    entry = get_or_parse_schema(KAFKA_SCHEMA_JSON)
+    schema = entry.fingerprint
+    band = costmodel.row_band(40)
+    for _ in range(4):  # teach both host arms: thread 1 ms, process 3 ms
+        costmodel.observe(schema, "decode", band, "native/c4/thread",
+                          40, 0.001)
+        costmodel.observe(schema, "decode", band, "native/c4/process",
+                          40, 0.003)
+
+    def decide():
+        return router.decide(
+            entry, "host", 40, op="decode", chunks=4,
+            candidates={"native": "impl"},
+            static=("native", "impl", None))
+
+    assert decide().arm == "native/c4/thread"  # cheaper, no penalty
+    # a mild drift (x1.6) inflates thread to 1.6 ms — still beats 3 ms
+    costmodel.penalize_arm(schema, "native/c4/thread", 60.0, factor=1.6)
+    base = costmodel.predict(schema, "decode", band,
+                             "native/c4/process", 40)
+    inflated = costmodel.predict(schema, "decode", band,
+                                 "native/c4/thread", 40)
+    assert inflated == pytest.approx(0.001 * 1.6, rel=0.05)
+    assert decide().arm == "native/c4/thread"
+    # a severe drift (x10) makes the alternative genuinely cheaper
+    costmodel.penalize_arm(schema, "native/c4/thread", 60.0, factor=10.0)
+    assert costmodel.arm_penalized(schema, "native/c4/thread")
+    dec = decide()
+    assert dec.arm == "native/c4/process"
+    assert dec.mode == "model"
+    assert base == pytest.approx(0.003, rel=0.05)  # others untouched
+
+
+# ---------------------------------------------------------------------------
+# flight-dir rotation
+# ---------------------------------------------------------------------------
+
+
+def test_flight_rotation_bounds_auto_dumps(tmp_path, monkeypatch):
+    monkeypatch.setenv("PYRUHVRO_TPU_FLIGHT_DIR", str(tmp_path))
+    monkeypatch.setenv("PYRUHVRO_TPU_FLIGHT_MAX_FILES", "3")
+    for i in range(6):
+        telemetry._flight_last_auto = 0.0  # defeat the 1/s rate limit
+        p = telemetry._flight_autodump(f"t{i}")
+        assert p is not None
+        os.utime(p, (i + 1, i + 1))  # deterministic mtime order
+    files = sorted(f for f in os.listdir(tmp_path)
+                   if f.startswith("flight_"))
+    assert len(files) == 3
+    # the newest three survived
+    assert all(any(f.endswith(f"t{i}.json") for f in files)
+               for i in (3, 4, 5))
+    assert metrics.snapshot().get("flight.dump_dropped") == 3.0
+
+
+def test_flight_rotation_spares_foreign_files(tmp_path):
+    (tmp_path / "operator_notes.json").write_text("{}")
+    # an operator's hand-saved dump matches flight_*.json but NOT the
+    # auto-dump shape flight_<pid>_<seq>_<tag>.json: never rotated,
+    # even as the oldest file in the directory
+    (tmp_path / "flight_incident.json").write_text("{}")
+    os.utime(tmp_path / "flight_incident.json", (0, 0))
+    for i in range(5):
+        (tmp_path / f"flight_1_{i}_x.json").write_text("{}")
+        os.utime(tmp_path / f"flight_1_{i}_x.json", (i + 1, i + 1))
+    dropped = telemetry._rotate_flight_dir(str(tmp_path), 2)
+    assert dropped == 3
+    left = sorted(os.listdir(tmp_path))
+    assert "operator_notes.json" in left
+    assert "flight_incident.json" in left
+    assert len([f for f in left if f.startswith("flight_1_")]) == 2
+
+
+def test_flight_rotation_unlimited_when_zero(tmp_path):
+    for i in range(4):
+        (tmp_path / f"flight_1_{i}_x.json").write_text("{}")
+    assert telemetry._rotate_flight_dir(str(tmp_path), 0) == 0
+    assert len(os.listdir(tmp_path)) == 4
+
+
+# ---------------------------------------------------------------------------
+# CLI: new subcommands + v2 snapshots with the new sections
+# ---------------------------------------------------------------------------
+
+
+def _v2_snapshot_with_new_sections(tmp_path):
+    """A real schema_version-2 snapshot carrying slo + sampling + drift
+    sections, written by the live exporters."""
+    os.environ["PYRUHVRO_TPU_SLO_FILE"] = _slo_file(tmp_path)
+    try:
+        data = kafka_style_datums(30, seed=21)
+        sampling.set_enabled(True)
+        for _ in range(8):
+            deserialize_array(data, KAFKA_SCHEMA_JSON, backend="host")
+        for _ in range(12):
+            drift.observe("fpCLI", "decode", 11, "native/c1/none", 1e-6)
+        snap = telemetry.snapshot()
+    finally:
+        sampling.set_enabled(None)
+        os.environ.pop("PYRUHVRO_TPU_SLO_FILE", None)
+        slo.reset()
+    assert snap["schema_version"] == 2
+    assert "slo" in snap and "sampling" in snap and "drift" in snap
+    path = tmp_path / "snap_v2.json"
+    path.write_text(json.dumps(snap, default=str))
+    return str(path)
+
+
+def test_clis_render_v2_snapshot_with_new_sections(tmp_path, capsys):
+    path = _v2_snapshot_with_new_sections(tmp_path)
+    for cmd in ("report", "prom", "perfetto", "route-report", "what-if",
+                "slo-report"):
+        assert telemetry.main([cmd, path]) == 0, cmd
+        out = capsys.readouterr().out
+        assert out, cmd
+        if cmd == "report":
+            assert "== slo ==" in out
+            assert "== adaptive deep sampling ==" in out
+            assert "== latency drift ==" in out
+        if cmd == "slo-report":
+            assert "t-decode" in out and "burn=" in out
+        if cmd == "prom":
+            assert "pyruhvro_tpu_slo_calls_total" in out
+        if cmd == "perfetto":
+            assert json.loads(out)["traceEvents"]
+
+
+def test_clis_degrade_on_snapshot_without_new_sections(capsys):
+    """A legacy (pre-plane) snapshot renders through every CLI without
+    the new sections and without errors."""
+    for cmd in ("report", "prom", "perfetto", "route-report", "what-if",
+                "slo-report"):
+        assert telemetry.main([cmd, LEGACY_SNAPSHOT]) == 0, cmd
+        out = capsys.readouterr().out
+        if cmd == "slo-report":
+            assert "no slo section" in out
+        if cmd == "report":
+            assert "== slo ==" not in out
+            assert "== adaptive deep sampling ==" not in out
+
+
+def test_new_clis_keep_exit2_contract(tmp_path, capsys):
+    assert telemetry.main(["slo-report", str(tmp_path / "nope.json")]) == 2
+    assert telemetry.main(["serve", str(tmp_path / "nope.json")]) == 2
+    bad = tmp_path / "bad.json"
+    bad.write_text("{not json")
+    assert telemetry.main(["slo-report", str(bad)]) == 2
+    assert telemetry.main(["serve", str(bad)]) == 2
+    notsnap = tmp_path / "notsnap.json"
+    notsnap.write_text('{"foo": 1}')
+    assert telemetry.main(["slo-report", str(notsnap)]) == 2
+    assert telemetry.main(["serve", str(notsnap)]) == 2
+    capsys.readouterr()
+
+
+def test_cli_serve_smoke(tmp_path):
+    """`telemetry serve` over a saved snapshot: spin the server class
+    the subcommand uses (static mode) and scrape it."""
+    path = _v2_snapshot_with_new_sections(tmp_path)
+    data = json.load(open(path))
+    server = obs_server.ObsServer(port=0, snapshot=data).start()
+    try:
+        code, body = _get(server.url + "/metrics")
+        assert code == 200 and b"pyruhvro_tpu_" in body
+        code, body = _get(server.url + "/healthz")
+        # the captured snapshot recorded an SLO breach -> 503 from disk
+        assert code == 503
+    finally:
+        server.stop()
+
+
+def test_snapshot_sections_omitted_when_inactive():
+    # a freshly-reset process exports NONE of the new sections
+    fresh = telemetry.snapshot()
+    for key in ("slo", "sampling", "drift"):
+        assert key not in fresh, key
+    # and without an SLO file / with the sampler off, calls add routing
+    # + drift evidence but still no slo/sampling sections
+    data = kafka_style_datums(5, seed=23)
+    sampling.set_enabled(False)
+    try:
+        deserialize_array(data, KAFKA_SCHEMA_JSON, backend="host")
+    finally:
+        sampling.set_enabled(None)
+    snap = telemetry.snapshot()
+    assert "slo" not in snap
+    assert "sampling" not in snap
