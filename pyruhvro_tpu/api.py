@@ -50,7 +50,17 @@ from .fallback.decoder import (
 )
 from .fallback.encoder import compile_encoder_plan, encode_record_batch
 from .fallback.io import MalformedAvro, max_datum_bytes, shift_malformed
-from .runtime import metrics, quarantine, router, sampling, telemetry
+from .runtime import (
+    breaker,
+    deadline,
+    faults,
+    metrics,
+    quarantine,
+    router,
+    sampling,
+    telemetry,
+)
+from .runtime.deadline import DeadlineExceeded
 from .runtime.chunking import bounds_rows, chunk_bounds
 from .runtime.pool import map_chunks, map_chunks_proc
 from .schema.cache import SchemaEntry, get_or_parse_schema
@@ -75,14 +85,36 @@ def _device_codec_ex(entry: SchemaEntry, backend: str):
     """
     if backend == "host":
         return None, "backend_host"
+    br = breaker.get("device_backend")
+    probing = False
     if backend == "auto" and entry._extras.get("device_failure") is not None:
-        # device codec for THIS schema already blew up; don't re-pay the
-        # failed (potentially seconds-long) init on every call. Other
-        # schemas still get the device path. Counted per call so a
-        # fallback storm is visible in snapshots, not just the one
-        # RuntimeWarning at first failure.
-        metrics.inc("route.device_failure")
-        return None, "device_failure_cached"
+        # device codec for THIS schema already blew up. The failure is
+        # no longer a permanent latch, but it is SCHEMA-SCOPED: one
+        # schema whose init deterministically fails must not starve
+        # every other schema of the device arm (and must not flap the
+        # shared breaker). The latch carries its own exponential retry
+        # schedule (breaker backoff knob/cap): while within backoff the
+        # cached verdict serves — no re-paying a seconds-long failed
+        # init per call — then ONE call clears the latch and retries
+        # the construction (failure re-latches with doubled backoff).
+        # An open device_backend breaker (call-time failures elsewhere)
+        # also withholds the retry. Counted per call so a fallback
+        # storm is visible in snapshots.
+        import time as _time
+
+        if (_time.monotonic() < entry._extras.get(
+                "device_failure_retry_at", 0.0) or not br.allow()):
+            metrics.inc("route.device_failure")
+            return None, "device_failure_cached"
+        probing = True
+        with entry._lock:
+            entry._extras.pop("device_failure", None)
+        _reset_failed_device_probe()
+    elif backend == "auto" and not br.allow():
+        # call-time device failures elsewhere opened the breaker: stop
+        # offering the device arm at all until the backoff expires
+        metrics.inc("route.device_breaker_open")
+        return None, "device_breaker_open"
     supported = device_supported(entry.ir)
     if backend == "auto" and not supported:
         return None, "gate_fail"
@@ -103,7 +135,13 @@ def _device_codec_ex(entry: SchemaEntry, backend: str):
         # backend: stay silent (reference fallback semantics)
         return None, "no_device_build"
     try:
-        return get_device_codec(entry), None
+        codec = get_device_codec(entry)
+        if probing:
+            # successful retry: forget the schema's backoff history
+            with entry._lock:
+                entry._extras.pop("device_failure_opens", None)
+                entry._extras.pop("device_failure_retry_at", None)
+        return codec, None
     except UnsupportedOnDevice:
         # schema outside the *device* subset (e.g. nested repetition): the
         # silent fallback here mirrors the reference's unsupported-schema
@@ -120,8 +158,19 @@ def _device_codec_ex(entry: SchemaEntry, backend: str):
         # failed device init) in the process-lifetime schema cache.
         if backend == "tpu":
             raise
+        import time as _time
+
         with entry._lock:
             entry._extras["device_failure"] = repr(e)
+            opens = int(entry._extras.get("device_failure_opens", 0)) + 1
+            entry._extras["device_failure_opens"] = opens
+            entry._extras["device_failure_retry_at"] = (
+                _time.monotonic() + breaker.backoff_schedule(opens))
+        # deliberately NOT br.record_failure(): a schema-scoped init
+        # failure must not open the process-wide breaker and withhold
+        # the device arm from healthy schemas. Backend-wide faults
+        # reach the breaker through their own feeds — the backend
+        # probe (ops/codec) and call-time launch failures.
         metrics.inc("route.device_failure")
         warnings.warn(
             f"pyruhvro_tpu device backend failed to initialize for this "
@@ -130,6 +179,18 @@ def _device_codec_ex(entry: SchemaEntry, backend: str):
             stacklevel=4,  # user -> api fn -> _route -> _device_codec_ex
         )
         return None, "device_failure"
+
+
+def _reset_failed_device_probe() -> None:
+    """Clear a FAILED backend-probe memo so a backoff-granted re-probe
+    actually re-runs the probe (a successful memo is never touched —
+    its devices/RTT verdicts stay valid for the process lifetime)."""
+    try:
+        from .ops import codec as _dev
+
+        _dev.reset_failed_probe()
+    except ImportError:
+        pass
 
 
 def _device_codec(entry: SchemaEntry, backend: str):
@@ -299,6 +360,20 @@ def _device_encode_available() -> bool:
     return _device_encode_available_memo
 
 
+def _native_degradable(e: BaseException) -> bool:
+    """Native-VM failures that justify degrading to the pure-Python
+    fallback decoder — the shared fault-domain taxonomy
+    (``runtime.faults.degradable``)."""
+    from .runtime import faults
+
+    return faults.degradable(e)
+
+
+def _count_native_degrade(e: BaseException) -> None:
+    metrics.inc("route.native_failure")
+    telemetry.annotate(native_degraded=type(e).__name__)
+
+
 def _host_reader(entry: SchemaEntry):
     """Per-schema memoized fallback wire reader (compile once, use on every
     call/chunk — the host analogue of the schema→kernel cache)."""
@@ -457,6 +532,11 @@ def _tolerant_decode(tier, impl, entry, data, base):
     first = True
     budget = 2 * len(pairs) + 16  # hard stop against no-progress loops
     while pairs:
+        # each resume is a unit of work that can be skipped: a blown
+        # wall-clock budget stops the salvage walk here, naming the
+        # first record it never reached (a deadline is a call contract
+        # and outranks the tolerant policy)
+        deadline.check(index=pairs[0][0], site="tolerant.resume")
         budget -= 1
         if budget <= 0:
             parts.append(_oracle_pairs(pairs, entry, quar))
@@ -487,6 +567,8 @@ def _tolerant_decode(tier, impl, entry, data, base):
                 if k:
                     try:
                         parts.append(tier_decode(items[:k], True))
+                    except DeadlineExceeded:
+                        raise
                     except Exception:
                         parts.append(
                             _oracle_pairs(pairs[:k], entry, quar))
@@ -497,6 +579,8 @@ def _tolerant_decode(tier, impl, entry, data, base):
             else:
                 parts.append(_oracle_pairs(pairs, entry, quar))
                 break
+        except DeadlineExceeded:
+            raise
         except Exception:
             # non-wire failure (capacity convergence, backend fault):
             # the oracle serves the remainder per record
@@ -612,6 +696,10 @@ def _proc_decode_task(payload):
     schema, data, base, on_error = payload
     with telemetry.worker_scope("pool.worker", rows=len(data),
                                 op="decode") as w:
+        # chaos seam INSIDE the spawned worker (the env-inherited fault
+        # spec applies here too): kind=error fails the chunk, kind=exit
+        # kills the worker process mid-fan-out
+        faults.fire("pool_worker")
         try:
             if on_error == "raise":
                 batch = deserialize_array(data, schema, backend="host")
@@ -637,6 +725,7 @@ def _proc_encode_task(payload):
     schema, batch, base, on_error = payload
     with telemetry.worker_scope("pool.worker", rows=batch.num_rows,
                                 op="encode") as w:
+        faults.fire("pool_worker")
         if on_error == "raise":
             [arr] = serialize_record_batch(batch, schema, 1, backend="host")
             errs = []
@@ -665,6 +754,10 @@ def _proc_map(task, payloads, rows):
     except MalformedAvro:
         metrics.inc("pool.worker_malformed")
         raise
+    except DeadlineExceeded:
+        # the budget is spent: degrading to the thread path would just
+        # blow it further — surface the structured expiry
+        raise
     except Exception:
         metrics.inc("pool.process_fallback")
         return None
@@ -673,6 +766,7 @@ def _proc_map(task, payloads, rows):
 def deserialize_array(
     data: Sequence[bytes], schema: str, *, backend: str = "auto",
     on_error: str = "raise", return_errors: bool = False,
+    timeout_s: Optional[float] = None,
 ) -> pa.RecordBatch:
     """Decode Avro datums into a single RecordBatch
     (≙ ``deserialize_array``, ``src/lib.rs:56-71``).
@@ -683,17 +777,29 @@ def deserialize_array(
     or ``"null"`` (quarantined AND, where every top-level field is
     nullable, replaced by an all-null row so the row count is
     preserved). ``return_errors=True`` returns
-    ``(batch, [QuarantinedRecord, ...])`` instead of the bare batch."""
+    ``(batch, [QuarantinedRecord, ...])`` instead of the bare batch.
+
+    ``timeout_s``: wall-clock budget for THIS call, enforced
+    cooperatively at chunk boundaries, tolerant-decode resumes and
+    device ladder rungs (:mod:`.runtime.deadline`); expiry raises a
+    structured :class:`DeadlineExceeded` regardless of ``on_error``
+    (a deadline is a call contract, not a data error). ``None`` defers
+    to ``PYRUHVRO_TPU_DEADLINE_S``; ``0`` expires at the first
+    checkpoint (the "would this call have blocked?" probe)."""
     _check_backend(backend)
     _check_on_error(on_error)
     entry = get_or_parse_schema(schema)
     with telemetry.root_span("api.deserialize_array", rows=len(data),
                              backend=backend, schema=entry.fingerprint), \
             sampling.call_scope("decode", entry.fingerprint,
-                                len(data)) as smp:
+                                len(data)) as smp, \
+            deadline.scope(timeout_s, op="deserialize_array"):
         dec = _decide(entry, backend, len(data), op="decode")
         dec.sampled = smp.sampled
         try:
+            # first checkpoint AFTER the routing decision: a timeout_s=0
+            # probe still produces a ledgered error observation
+            deadline.check(site="call_start")
             out = _deserialize_one(dec, entry, data, on_error,
                                    return_errors)
         except Exception as e:
@@ -708,9 +814,19 @@ def _deserialize_one(dec, entry, data, on_error, return_errors):
     tier, impl = dec.tier, dec.impl
     if on_error == "raise":
         _enforce_max_datum(data)
+        batch = None
         if tier != "fallback":
-            batch = impl.decode(data)
-        else:
+            try:
+                batch = impl.decode(data)
+            except Exception as e:
+                # the native VM is a degradation seam like the device
+                # tier (which degrades inside its codec): a runtime
+                # fault falls back to the pure-Python oracle; data/
+                # capacity/deadline errors propagate
+                if tier != "native" or not _native_degradable(e):
+                    raise
+                _count_native_degrade(e)
+        if batch is None:
             with telemetry.phase("fallback.decode_s", rows=len(data)):
                 batch = decode_to_record_batch(
                     data, entry.ir, entry.arrow_schema,
@@ -732,7 +848,7 @@ def _deserialize_one(dec, entry, data, on_error, return_errors):
 def deserialize_array_threaded(
     data: Sequence[bytes], schema: str, num_chunks: int, *,
     backend: str = "auto", on_error: str = "raise",
-    return_errors: bool = False,
+    return_errors: bool = False, timeout_s: Optional[float] = None,
 ) -> List[pa.RecordBatch]:
     """Decode in ``num_chunks`` chunks → one RecordBatch per chunk
     (≙ ``deserialize_array_threaded``, ``src/lib.rs:73-89``).
@@ -743,7 +859,8 @@ def deserialize_array_threaded(
     (``parallel/sharded.py``); on a single chip the whole input is
     decoded in one fused launch and sliced per chunk.
 
-    ``on_error``/``return_errors``: see :func:`deserialize_array`.
+    ``on_error``/``return_errors``/``timeout_s``: see
+    :func:`deserialize_array`.
     Chunk boundaries are computed on the INPUT rows; under ``"skip"``
     a chunk's batch holds its surviving rows (``"null"`` preserves the
     per-chunk row count on all-nullable schemas)."""
@@ -755,11 +872,13 @@ def deserialize_array_threaded(
                              rows=len(data), chunks=num_chunks,
                              backend=backend, schema=entry.fingerprint), \
             sampling.call_scope("decode", entry.fingerprint,
-                                len(data)) as smp:
+                                len(data)) as smp, \
+            deadline.scope(timeout_s, op="deserialize_array_threaded"):
         dec = _decide(entry, backend, len(data), op="decode",
                       chunks=len(bounds))
         dec.sampled = smp.sampled
         try:
+            deadline.check(site="call_start")
             out = _deserialize_chunks(dec, entry, data, schema,
                                       num_chunks, bounds, on_error,
                                       return_errors)
@@ -788,8 +907,13 @@ def _deserialize_chunks(dec, entry, data, schema, num_chunks, bounds,
                 return (out, []) if return_errors else out
             dec.degraded = True  # thread path serves a process-arm call
         if tier != "fallback":
-            out = impl.decode_threaded(data, num_chunks)
-            return (out, []) if return_errors else out
+            try:
+                out = impl.decode_threaded(data, num_chunks)
+                return (out, []) if return_errors else out
+            except Exception as e:
+                if tier != "native" or not _native_degradable(e):
+                    raise
+                _count_native_degrade(e)  # fallback chunks serve below
         ir, arrow = entry.ir, entry.arrow_schema
         reader = _host_reader(entry)
 
@@ -835,6 +959,8 @@ def _deserialize_chunks(dec, entry, data, schema, num_chunks, bounds,
             if tier != "fallback" and not max_datum_bytes():
                 try:
                     out = impl.decode_threaded(data, num_chunks)
+                except DeadlineExceeded:
+                    raise  # a call contract, not a reason to re-decode
                 except Exception:
                     out = None
         if out is None:
@@ -862,20 +988,20 @@ def _deserialize_chunks(dec, entry, data, schema, num_chunks, bounds,
 def deserialize_array_threaded_spawn(
     data: Sequence[bytes], schema: str, num_chunks: int, *,
     backend: str = "auto", on_error: str = "raise",
-    return_errors: bool = False,
+    return_errors: bool = False, timeout_s: Optional[float] = None,
 ) -> List[pa.RecordBatch]:
     """Signature-parity alias of :func:`deserialize_array_threaded`
     (≙ ``src/lib.rs:108-128``; thread-pool flavor is a host-side detail)."""
     return deserialize_array_threaded(
         data, schema, num_chunks, backend=backend, on_error=on_error,
-        return_errors=return_errors,
+        return_errors=return_errors, timeout_s=timeout_s,
     )
 
 
 def serialize_record_batch(
     batch: pa.RecordBatch, schema: str, num_chunks: int, *,
     backend: str = "auto", on_error: str = "raise",
-    return_errors: bool = False,
+    return_errors: bool = False, timeout_s: Optional[float] = None,
 ) -> List[pa.Array]:
     """Encode a RecordBatch into Avro datums, one BinaryArray per chunk
     (≙ ``serialize_record_batch``, ``src/lib.rs:91-106``).
@@ -901,11 +1027,13 @@ def serialize_record_batch(
                              rows=batch.num_rows, chunks=num_chunks,
                              backend=backend, schema=entry.fingerprint), \
             sampling.call_scope("encode", entry.fingerprint,
-                                batch.num_rows) as smp:
+                                batch.num_rows) as smp, \
+            deadline.scope(timeout_s, op="serialize_record_batch"):
         dec = _decide(entry, backend, batch.num_rows, op="encode",
                       chunks=len(bounds), need_encode=True)
         dec.sampled = smp.sampled
         try:
+            deadline.check(site="call_start")
             out = _serialize_chunks(dec, entry, batch, schema,
                                     num_chunks, bounds, on_error,
                                     return_errors)
@@ -933,8 +1061,15 @@ def _serialize_chunks(dec, entry, batch, schema, num_chunks, bounds,
                 return (out, []) if return_errors else out
             dec.degraded = True  # thread path serves a process-arm call
         if tier != "fallback":
-            out = impl.encode_threaded(batch, num_chunks)
-            return (out, []) if return_errors else out
+            try:
+                out = impl.encode_threaded(batch, num_chunks)
+                return (out, []) if return_errors else out
+            except Exception as e:
+                # BatchTooLarge (a capacity contract) is not a
+                # RuntimeError and propagates untouched
+                if tier != "native" or not _native_degradable(e):
+                    raise
+                _count_native_degrade(e)  # fallback encode serves below
         ir = entry.ir
         plan = entry.get_extra(
             "host_encode_plan", lambda: compile_encoder_plan(ir)
@@ -989,11 +1124,11 @@ def _serialize_chunks(dec, entry, batch, schema, num_chunks, bounds,
 def serialize_record_batch_spawn(
     batch: pa.RecordBatch, schema: str, num_chunks: int, *,
     backend: str = "auto", on_error: str = "raise",
-    return_errors: bool = False,
+    return_errors: bool = False, timeout_s: Optional[float] = None,
 ) -> List[pa.Array]:
     """Signature-parity alias of :func:`serialize_record_batch`
     (≙ ``src/lib.rs:130-147``)."""
     return serialize_record_batch(
         batch, schema, num_chunks, backend=backend, on_error=on_error,
-        return_errors=return_errors,
+        return_errors=return_errors, timeout_s=timeout_s,
     )

@@ -116,9 +116,12 @@ class NativeHostCodec:
         self._rows_seen = 0
         # Arrow-native extraction (runtime/native/extract.cpp): probed
         # lazily; PYRUHVRO_TPU_NO_NATIVE_EXTRACT=1 pins the Python
-        # extractor (the differential oracle for the native one)
+        # extractor (the differential oracle for the native one).
+        # Transient lane failures are no longer a permanent latch: the
+        # process-wide ``native_extract`` circuit breaker decides when
+        # the lane is withheld and when a half-open probe re-admits it.
         self._extract_mod = None
-        self._extract_failed = (
+        self._extract_pinned = (
             os.environ.get("PYRUHVRO_TPU_NO_NATIVE_EXTRACT") == "1"
         )
         # the last Arrow schema the native extractor declined on SHAPE:
@@ -162,6 +165,13 @@ class NativeHostCodec:
                 deep_mod = sampling.prof_codec_module()
         with telemetry.phase("host.decode_s", rows=n):
             self._maybe_specialize(n)
+            # fault seam + cooperative deadline checkpoint before the
+            # (uninterruptible) VM pass; index-aware like the VM's own
+            # malformed-record reporting
+            from ..runtime import deadline, faults
+
+            deadline.check(index=index_base, site="host.vm")
+            faults.fire("vm_decode")
             # records decode straight from the caller's bytes objects (span
             # collection in C++, ≙ extract_bytes_list src/lib.rs:29-33) —
             # no concatenation pass exists on this path at all
@@ -248,9 +258,15 @@ class NativeHostCodec:
 
         bounds = chunk_bounds(len(data), num_chunks)
         if len(data) >= self._PER_CHUNK_ROWS * max(len(bounds), 1):
+            from ..runtime import deadline
+
             with fanout_stats(len(bounds), serial=True) as stats:
                 out = []
                 for a, b in bounds:
+                    # per-chunk deadline checkpoint: an expired budget
+                    # stops the serial chunk walk at a chunk boundary,
+                    # naming the first row it never decoded
+                    deadline.check(index=a, site="host.chunk")
                     t0 = _time.perf_counter()
                     out.append(self.decode(data[a:b], index_base=a))
                     stats.chunk(_time.perf_counter() - t0)
@@ -265,15 +281,19 @@ class NativeHostCodec:
 
     def _native_extract_mod(self):
         """The generic Arrow-native extractor module, or None (toolchain
-        missing, stale binary, or disabled by env). Probed once."""
-        if self._extract_failed:
+        missing, stale binary, or disabled by env). The module memo is
+        per-codec; a load failure feeds the ``native_extract`` breaker
+        (the builder's own memo makes re-probes cheap)."""
+        if self._extract_pinned:
             return None
         if self._extract_mod is None:
             from ..runtime.native.build import load_extract
 
             mod = load_extract()
             if mod is None or not hasattr(mod, "encode"):
-                self._extract_failed = True
+                from ..runtime import breaker
+
+                breaker.get("native_extract").record_failure()
                 return None
             self._extract_mod = mod
         return self._extract_mod
@@ -300,22 +320,64 @@ class NativeHostCodec:
         Python extractor words precisely, stale/missing module) — the
         caller falls back to ``run_extractor`` and counts it."""
         from ..ops.decode import BatchTooLarge
-        from ..ops.encode import batch_to_struct
-        from ..runtime import metrics, telemetry
+        from ..runtime import breaker, faults, metrics
 
-        if self._extract_failed:  # PYRUHVRO_TPU_NO_NATIVE_EXTRACT / probe
+        if self._extract_pinned:  # PYRUHVRO_TPU_NO_NATIVE_EXTRACT
+            return None
+        br = breaker.get("native_extract")
+        if not br.acquire():
+            # lane withheld while its breaker is open; half-open admits
+            # one probe encode, whose success below re-closes it
+            metrics.inc("extract.fallback")
+            metrics.inc("extract.breaker_open")
             return None
         if (self._extract_declined_schema is not None
                 and batch.schema.equals(self._extract_declined_schema)):
             metrics.inc("extract.fallback")
             metrics.inc("extract.fallback_shape")
+            # a memo-served shape decline runs NO native code: it must
+            # not read as probe success (that would close a half-open
+            # breaker — and reset its backoff exponent — with zero
+            # evidence the lane works); release the slot verdict-free
+            br.release()
             return None
         spec = self._spec if (
             self._spec is not None and hasattr(self._spec, "encode_arrow")
         ) else None
         mod = None if spec is not None else self._native_extract_mod()
         if spec is None and mod is None:
+            return None  # _native_extract_mod already fed the breaker
+        try:
+            faults.fire("native_extract")
+        except faults.FaultInjected:
+            br.record_failure()
+            metrics.inc("extract.fallback")
+            metrics.inc("extract.fallback_fault")
             return None
+        try:
+            return self._encode_native_admitted(
+                batch, n, checked, br, spec, mod)
+        except (BatchTooLarge, OverflowError):
+            # contract/data conditions raised THROUGH the lane: the
+            # native call itself executed correctly, so a half-open
+            # probe reads success — without a verdict here, a raising
+            # exit would wedge the probe slot for the TTL and withhold
+            # a healthy lane
+            br.record_success()
+            raise
+        except BaseException:
+            br.release()  # no verdict — but never wedge the probe slot
+            raise
+
+    def _encode_native_admitted(self, batch: pa.RecordBatch, n: int,
+                                checked: int, br, spec, mod):
+        """The admitted half of :meth:`_encode_native` — every return
+        path below delivers its own breaker verdict; raising paths are
+        resolved by the caller's except clauses."""
+        from ..ops.decode import BatchTooLarge
+        from ..ops.encode import batch_to_struct
+        from ..runtime import metrics, telemetry
+
         struct = batch_to_struct(self.ir, batch)
         # ArrowArray is 80 ABI bytes, ArrowSchema 72; the C++ side moves
         # both structs out and releases them before returning
@@ -342,10 +404,11 @@ class NativeHostCodec:
             raise BatchTooLarge(n, -1)
         except TypeError:
             # a stale pinned .so with a pre-fused signature (build.py
-            # keeps a usable old binary when rebuild fails): disable the
-            # native lane for this codec instead of crashing every call
-            # — the buffer-fed path guards the same scenario below
-            self._extract_failed = True
+            # keeps a usable old binary when rebuild fails): the lane
+            # declines through the breaker instead of crashing every
+            # call — a stale binary never heals in-process, so probes
+            # keep failing and the breaker keeps it open at backoff cost
+            br.record_failure()
             metrics.inc("extract.fallback")
             metrics.inc("extract.fallback_stale")
             return None
@@ -354,13 +417,16 @@ class NativeHostCodec:
         if isinstance(res, int):
             # 1 = arrow shape outside the native surface; 2 = a data
             # error the Python extractor reports with its exact message
+            # — neither is a LANE fault, so the breaker reads success
             metrics.inc("extract.fallback")
             metrics.inc("extract.fallback_data" if res == 2
                         else "extract.fallback_shape")
             if res == 1:
                 self._extract_declined_schema = batch.schema
+            br.record_success()
             return None
         blob, sizes, t_ex, t_enc = res
+        br.record_success()
         telemetry.observe("host.extract_s", t_ex, rows=n, native=True)
         telemetry.observe("host.extract_native_s", t_ex, rows=n)
         telemetry.observe("host.encode_vm_s", t_enc, fused=True,

@@ -82,6 +82,44 @@ def interconnect_rtt_s() -> float:
     return best
 
 
+def reset_failed_probe() -> None:
+    """Forget a FAILED backend probe (and the RTT figure derived while
+    it was failing) so the next construction re-probes —
+    ``api._device_codec_ex`` calls this when a schema's device-failure
+    backoff grants a retry. A successful probe memo is never cleared."""
+    if _probe_result and isinstance(_probe_result[0], BaseException):
+        _probe_result.clear()
+        _rtt_result.clear()
+
+
+def _degradable(e: BaseException) -> bool:
+    """Failures that justify degrading a device call to the host path —
+    the shared fault-domain taxonomy (``runtime.faults.degradable``)."""
+    from ..runtime import faults
+
+    return faults.degradable(e)
+
+
+def _device_call_failed(e: BaseException) -> None:
+    """Record one call-time device failure: counted, span-annotated and
+    fed to the ``device_backend`` breaker — enough consecutive failures
+    open it and the router stops offering device arms until the
+    half-open probe proves the backend back."""
+    from ..runtime import breaker, metrics, telemetry
+
+    metrics.inc("device.call_failure")
+    telemetry.annotate(device_degraded=type(e).__name__)
+    breaker.get("device_backend").record_failure()
+
+
+def _device_call_ok() -> None:
+    """A device call completed: reset the breaker's failure streak (and
+    close it when this call was the half-open probe)."""
+    from ..runtime import breaker
+
+    breaker.get("device_backend").record_success()
+
+
 def devices_cpu_only() -> bool:
     """True when the RESOLVED backend probe found only host-CPU devices
     — the routing signal ``backend="auto"`` uses to skip the device
@@ -134,8 +172,15 @@ def _probe_backend() -> None:
         )
         _probe_result.append(e)
     out = _probe_result[0]
+    # a FRESH probe verdict is backend-wide evidence (no schema in
+    # sight), so it feeds the shared breaker directly — memo re-reads
+    # above must not re-count the same broken state
+    from ..runtime import breaker
+
     if isinstance(out, BaseException):
+        breaker.get("device_backend").record_failure()
         raise RuntimeError(f"JAX backend unavailable: {out!r}") from out
+    breaker.get("device_backend").record_success()
 
 
 class DeviceCodec:
@@ -232,8 +277,17 @@ class DeviceCodec:
             # per-batch limits of an alternative walk (e.g. the Pallas
             # kernel's per-record tile budget): host path, silently
             return self._host_decode(data)
+        except Exception as e:
+            # a transient backend fault (wedged launch, injected chaos)
+            # degrades THIS call to the host path and feeds the
+            # device_backend breaker; data errors / deadlines propagate
+            if not _degradable(e):
+                raise
+            _device_call_failed(e)
+            return self._host_decode(data)
         from .arrow_build import build_record_batch
 
+        _device_call_ok()
         return build_record_batch(self.ir, self.arrow_schema, host, n, meta)
 
     def _sharded_decoder(self):
@@ -281,6 +335,13 @@ class DeviceCodec:
                 return map_chunks(
                     lambda ab: self._host_decode(data[ab[0]:ab[1]]), bounds
                 )
+            except Exception as e:
+                if not _degradable(e):
+                    raise
+                # sharded launch fault: fall through to the single-chip
+                # fused path (which carries its own host fallback)
+                _device_call_failed(e)
+                batches = None
             if batches is not None:
                 if len(batches) == len(bounds):
                     # mesh shards used reference slicing too → exact match
@@ -326,7 +387,9 @@ class DeviceCodec:
         if self._encoder is False:
             return self._host_encode(batch)
         try:
-            return self._encoder.encode(batch)
+            out = self._encoder.encode(batch)
+            _device_call_ok()
+            return out
         except BatchTooLarge:
             # output would blow the 2^30-byte launch budget: halve the
             # batch (still on device), or for one giant row go host
@@ -342,6 +405,15 @@ class DeviceCodec:
                 # halves fit individually but their concatenation blows
                 # int32 offsets (≙ hostpath _encode_split)
                 raise BatchTooLarge(batch.num_rows, -1) from None
+        except Exception as e:
+            # same degradation contract as decode(): backend faults go
+            # host-side and feed the breaker; value errors (the
+            # tolerant-encode bisect relies on them), capacity and
+            # deadline expiry propagate
+            if not _degradable(e):
+                raise
+            _device_call_failed(e)
+            return self._host_encode(batch)
 
     def _host_encode(self, batch: pa.RecordBatch) -> pa.Array:
         """Host-path encode for schemas/batches the device encoder hands

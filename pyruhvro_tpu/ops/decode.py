@@ -33,7 +33,7 @@ import numpy as np
 import time
 
 from ..fallback.io import MalformedAvro, malformed_record
-from ..runtime import device_obs, metrics, telemetry
+from ..runtime import deadline, device_obs, faults, metrics, telemetry
 from ..runtime.pack import bucket_len, concat_records
 from .fieldprog import ROWS, Program, lower
 from .varint import ERR_ITEM_OVERFLOW, ERR_NAMES, ERR_SLUGS
@@ -658,6 +658,7 @@ class DeviceDecoder:
         packed = pack_launch_input(words, starts, lengths, n)
 
         with telemetry.phase("decode.h2d_s", bytes=packed.nbytes):
+            faults.fire("h2d")
             packed_d = jax.device_put(packed)
         metrics.inc("decode.h2d_bytes", packed.nbytes)
         metrics.inc("device.h2d_bytes", packed.nbytes)
@@ -667,6 +668,10 @@ class DeviceDecoder:
         # zero-byte items (null / empty-record) reveal their true count only
         # ~cap-at-a-time, so cap growth can take ~log2(_MAX_ITEM_CAP) rounds
         for _attempt in range(24):
+            # each capacity-ladder rung is a compile + launch: a
+            # deadline-bounded call stops climbing when the budget is
+            # spent instead of paying rungs it can no longer afford
+            deadline.check(site="device.capacity_ladder")
             item_caps, tot_caps = self.caps_snapshot(R)
             compact = (R, B) not in self._str_full
             fn, layout = self._pipeline_fn(R, B, item_caps, tot_caps,

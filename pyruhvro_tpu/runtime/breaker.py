@@ -1,0 +1,279 @@
+"""Half-open circuit breakers: recoverable replacements for every
+permanent self-disable.
+
+Before this module, three seams latched failure forever:
+
+* ``pool._proc_broken`` — one ``BrokenProcessPool`` and the spawn pool
+  was gone for the process lifetime;
+* the device-availability memo (``ops/codec._probe_result`` + the
+  per-schema ``device_failure`` latch in ``api._device_codec_ex``) —
+  a transient backend hiccup at probe time meant host-only forever.
+  (The per-SCHEMA latch retries on its own :func:`backoff_schedule`
+  rather than through the shared ``device_backend`` breaker: one
+  schema with a deterministically-failing init must not withhold the
+  device arm from every other schema);
+* the native-extract latch (``NativeHostCodec._extract_failed``) — one
+  bad probe and the fused C++ encode lane never ran again.
+
+A long-lived serving process (ROADMAP item 2) cannot afford "forever":
+a wedged transport that recovers in 30 s must cost 30 s of degraded
+calls, not a restart. Each seam now owns a named
+:class:`CircuitBreaker`:
+
+* **closed** — normal operation; failures count, successes reset.
+* **open** — the seam is withheld (the router stops offering its arm,
+  callers degrade immediately without paying the failure). Entered when
+  consecutive failures reach the threshold; exit is time-based:
+  exponential backoff (base × 2^(opens-1), capped).
+* **half-open** — backoff expired: exactly ONE probe call is admitted
+  (others still see open). Probe success closes the breaker; probe
+  failure re-opens it with doubled backoff.
+
+Knobs: ``PYRUHVRO_TPU_BREAKER_THRESHOLD`` (failures to open; overrides
+every breaker's default) and ``PYRUHVRO_TPU_BREAKER_BACKOFF`` (base
+backoff seconds). State changes count ``breaker.<name>.opened`` /
+``.half_open`` / ``.closed`` and mark ``breaker_open`` for the
+``/healthz`` window; live state is exported in
+``telemetry.snapshot()["breakers"]`` and the ``/healthz``
+``degraded_bits``.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Any, Dict, Optional
+
+from . import metrics
+
+__all__ = [
+    "CircuitBreaker",
+    "get",
+    "snapshot_breakers",
+    "backoff_schedule",
+    "reset",
+]
+
+_MAX_BACKOFF_S = 60.0
+# a half-open probe that never reports back (its call path ended without
+# reaching a record_* hook) must not wedge the breaker: after this long
+# the probe slot is forfeited and the next caller may probe again
+_PROBE_TTL_S = 30.0
+
+
+def _env_threshold() -> Optional[int]:
+    raw = os.environ.get("PYRUHVRO_TPU_BREAKER_THRESHOLD", "").strip()
+    if not raw:
+        return None
+    try:
+        return max(1, int(raw))
+    except ValueError:
+        return None
+
+
+def _env_backoff() -> Optional[float]:
+    raw = os.environ.get("PYRUHVRO_TPU_BREAKER_BACKOFF", "").strip()
+    if not raw:
+        return None
+    try:
+        return max(0.0, float(raw))
+    except ValueError:
+        return None
+
+
+class CircuitBreaker:
+    """One named breaker (thread-safe). ``threshold``/``backoff_s`` are
+    per-seam defaults; the env knobs override both when set (read per
+    transition, so tests can flip them in-process)."""
+
+    __slots__ = ("name", "_threshold", "_backoff_s", "_lock", "_failures",
+                 "_opens", "_state", "_open_until", "_probe_at")
+
+    def __init__(self, name: str, threshold: int = 3,
+                 backoff_s: float = 1.0):
+        self.name = name
+        self._threshold = max(1, int(threshold))
+        self._backoff_s = max(0.0, float(backoff_s))
+        self._lock = threading.Lock()
+        self._failures = 0
+        self._opens = 0          # consecutive opens (backoff exponent)
+        self._state = "closed"
+        self._open_until = 0.0
+        self._probe_at: Optional[float] = None  # half-open probe start
+
+    # -- knobs --------------------------------------------------------------
+
+    def threshold(self) -> int:
+        return _env_threshold() or self._threshold
+
+    def base_backoff_s(self) -> float:
+        env = _env_backoff()
+        return self._backoff_s if env is None else env
+
+    def _next_backoff_s(self) -> float:
+        return backoff_schedule(self._opens, self.base_backoff_s())
+
+    # -- state machine ------------------------------------------------------
+
+    def _state_locked(self, now: float) -> str:
+        """Current state, promoting open→half_open when the backoff has
+        expired and reclaiming a leaked half-open probe slot."""
+        if self._state == "open" and now >= self._open_until:
+            self._state = "half_open"
+            self._probe_at = None
+            metrics.inc(f"breaker.{self.name}.half_open")
+        if (self._state == "half_open" and self._probe_at is not None
+                and now - self._probe_at > _PROBE_TTL_S):
+            self._probe_at = None  # forfeited probe: allow another
+        return self._state
+
+    def state(self) -> str:
+        with self._lock:
+            return self._state_locked(time.monotonic())
+
+    def allow(self) -> bool:
+        """Non-consuming peek: can a call go through right now? True in
+        closed and half-open (someone may probe), False while open."""
+        return self.state() != "open"
+
+    def acquire(self) -> bool:
+        """Admission check for one call. Closed → True. Open → False.
+        Half-open → True for exactly one in-flight probe (the caller
+        MUST end with :meth:`record_success` or :meth:`record_failure`);
+        concurrent callers are refused until the probe reports (or its
+        TTL lapses)."""
+        with self._lock:
+            st = self._state_locked(time.monotonic())
+            if st == "closed":
+                return True
+            if st == "open":
+                return False
+            if self._probe_at is not None:
+                return False
+            self._probe_at = time.monotonic()
+            metrics.inc(f"breaker.{self.name}.probe")
+            return True
+
+    def record_success(self) -> None:
+        """A call through the seam succeeded: reset failures; a
+        half-open probe success closes the breaker for good (the
+        backoff exponent resets too)."""
+        with self._lock:
+            self._failures = 0
+            self._probe_at = None
+            if self._state != "closed":
+                self._state = "closed"
+                self._opens = 0
+                metrics.inc(f"breaker.{self.name}.closed")
+
+    def record_failure(self) -> None:
+        """A call through the seam failed. In half-open (failed probe)
+        or past the threshold in closed: open with exponential backoff.
+        """
+        with self._lock:
+            now = time.monotonic()
+            st = self._state_locked(now)
+            self._failures += 1
+            self._probe_at = None
+            if st == "half_open" or (st == "closed"
+                                     and self._failures >= self.threshold()):
+                self._opens += 1
+                self._state = "open"
+                self._open_until = now + self._next_backoff_s()
+                metrics.inc(f"breaker.{self.name}.opened")
+                metrics.mark("breaker_open")
+
+    def release(self) -> None:
+        """Return an acquired half-open probe slot WITHOUT a verdict:
+        the call exited through a path that proves nothing about the
+        seam (e.g. a data/contract error raised before the probed work
+        could succeed or fail). Without this, a raising exit between
+        :meth:`acquire` and a ``record_*`` call would wedge the
+        half-open slot for the probe TTL."""
+        with self._lock:
+            self._probe_at = None
+
+    def force_open(self, backoff_s: Optional[float] = None) -> None:
+        """Open immediately (tests / operator escape hatch)."""
+        with self._lock:
+            self._opens += 1
+            self._state = "open"
+            self._open_until = time.monotonic() + (
+                self._next_backoff_s() if backoff_s is None
+                else max(0.0, backoff_s))
+            self._probe_at = None
+            metrics.inc(f"breaker.{self.name}.opened")
+            metrics.mark("breaker_open")
+
+    def export(self) -> Dict[str, Any]:
+        with self._lock:
+            now = time.monotonic()
+            st = self._state_locked(now)
+            out: Dict[str, Any] = {
+                "state": st,
+                "failures": self._failures,
+                "opens": self._opens,
+                "threshold": self.threshold(),
+            }
+            if st == "open":
+                out["reopen_in_s"] = round(max(0.0, self._open_until - now),
+                                           3)
+            if st == "half_open" and self._probe_at is not None:
+                out["probe_inflight"] = True
+            return out
+
+
+_lock = threading.Lock()
+_registry: Dict[str, CircuitBreaker] = {}
+
+# per-seam defaults: the spawn pool and the device backend open on the
+# FIRST failure (a broken pool / wedged transport is heavyweight to
+# re-discover — the pre-breaker behavior, now with recovery); the
+# native-extract lane tolerates a couple (its failures are cheap and
+# the fallback is warm)
+_DEFAULTS = {
+    "process_pool": (1, 1.0),
+    "device_backend": (1, 1.0),
+    "native_extract": (2, 1.0),
+}
+
+
+def get(name: str) -> CircuitBreaker:
+    """The process-wide breaker for ``name`` (created on first use)."""
+    br = _registry.get(name)
+    if br is None:
+        with _lock:
+            br = _registry.get(name)
+            if br is None:
+                thr, backoff = _DEFAULTS.get(name, (3, 1.0))
+                br = _registry[name] = CircuitBreaker(
+                    name, threshold=thr, backoff_s=backoff)
+    return br
+
+
+def snapshot_breakers() -> Dict[str, Any]:
+    """Live state of every instantiated breaker — the ``breakers``
+    section of ``telemetry.snapshot()`` and the ``/healthz`` degraded
+    bits. Empty dict when no breaker was ever touched."""
+    with _lock:
+        items = list(_registry.items())
+    return {name: br.export() for name, br in sorted(items)}
+
+
+def backoff_schedule(opens: int, base_s: float = 1.0) -> float:
+    """The exponential backoff shared by every breaker AND the
+    schema-scoped device-failure retry memo (``api._device_codec_ex``):
+    ``base × 2^(opens-1)``, capped, env-overridable base."""
+    env = _env_backoff()
+    base = base_s if env is None else env
+    return min(_MAX_BACKOFF_S, base * (2.0 ** max(0, opens - 1)))
+
+
+def reset() -> None:
+    """Drop every breaker. Test isolation ONLY (tests/conftest.py calls
+    it alongside — deliberately NOT from — ``telemetry.reset()``:
+    breaker state is operational, and wiping it with the metrics would
+    silently re-admit a broken seam)."""
+    with _lock:
+        _registry.clear()

@@ -447,16 +447,19 @@ def load_profile(path: Optional[str] = None) -> bool:
     corrupt, or stale-version file is a COLD START, not an error:
     counted as ``router.profile_load_error`` and the process routes
     statically until it learns — never raises."""
+    from . import faults
+
     path = path or profile_path()
     if not path:
         return False
     try:
+        faults.fire("profile_load")
         with open(path, encoding="utf-8") as f:
             doc = json.load(f)
         merge_doc(doc, loaded=True)
     except FileNotFoundError:
         return False  # no profile yet is the normal first run, not an error
-    except (OSError, ValueError):
+    except (OSError, ValueError, faults.FaultInjected):
         metrics.inc("router.profile_load_error")
         return False
     metrics.inc("router.profile_loaded")
@@ -512,18 +515,14 @@ def save_profile(path: Optional[str] = None) -> Optional[str]:
             ],
             "saved_unix": round(time.time(), 3),
         }
-        tmp = f"{path}.tmp{os.getpid()}"
+        from . import faults, fsio
+
         try:
-            with open(tmp, "w", encoding="utf-8") as f:
-                json.dump(doc, f, indent=1, sort_keys=True)
-                f.write("\n")
-            os.replace(tmp, path)
-        except OSError:
+            faults.fire("profile_save")
+            fsio.atomic_write_json(path, doc, sort_keys=True,
+                                   default=None)
+        except (OSError, ValueError, faults.FaultInjected):
             metrics.inc("router.profile_save_error")
-            try:
-                os.remove(tmp)
-            except OSError:
-                pass
             return None
     finally:
         if lock_fh is not None:

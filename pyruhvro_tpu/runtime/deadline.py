@@ -1,0 +1,278 @@
+"""Per-call deadlines: every API call can be bounded, cooperatively.
+
+Until now the only watchdog in the tree was the one-off backend probe
+in ``ops/codec.py`` — a wedged device launch, a hung pool worker or a
+pathological capacity ladder could hold a caller forever. Every public
+API function now takes ``timeout_s=`` (``PYRUHVRO_TPU_DEADLINE_S`` is
+the process-wide default; the kwarg wins), enforced **cooperatively**:
+
+* a thread-local absolute deadline opens with :class:`scope` at the API
+  boundary; nesting takes the tighter bound;
+* :func:`check` runs at every chunk boundary (thread and process
+  fan-outs), each tolerant-decode resume, and each device
+  capacity-ladder rung — the places where one unit of work ends and
+  the next could be skipped;
+* pool fan-outs wait on their futures with the REMAINING budget and
+  cancel what has not started (bounded ``cancel_futures`` semantics —
+  running chunks cannot be interrupted, but the caller stops waiting);
+* device compiles/launches run under :func:`run_bounded` — the
+  generalized ``ops/codec.py`` probe pattern: the XLA call runs on a
+  watchdog thread joined with the remaining budget, so a wedged
+  transport costs one bounded call, not the process.
+
+Expiry raises :class:`DeadlineExceeded` — structured (op, budget,
+elapsed, the global row index where expiry was detected when known,
+and the site that detected it), pickle-safe across the spawn pool, and
+index-aware like ``MalformedAvro``. The router ledgers the expiry as an
+error observation AND teaches the cost model the blown-budget wall
+seconds, so an arm that keeps blowing deadlines prices itself out; at
+decision time arms whose predicted cost already exceeds the remaining
+budget are skipped (``router.deadline_skip``).
+
+``timeout_s=0`` means "no budget at all": the call raises at its first
+checkpoint, before any tier work — the probe for "would this call have
+blocked?". ``timeout_s=None`` (default) defers to the env knob; no knob
+= unbounded (pre-deadline behavior, zero overhead beyond one TLS read).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Any, Callable, Optional
+
+__all__ = [
+    "DeadlineExceeded",
+    "scope",
+    "attach",
+    "current",
+    "active",
+    "remaining",
+    "check",
+    "run_bounded",
+    "default_timeout_s",
+]
+
+_tls = threading.local()
+
+
+class DeadlineExceeded(RuntimeError):
+    """A call blew its ``timeout_s`` budget.
+
+    Structured like ``MalformedAvro``: ``op`` (which API call),
+    ``budget_s`` / ``elapsed_s``, ``index`` (the global row index at
+    which expiry was detected, when the checkpoint knew one), ``site``
+    (which checkpoint fired) and ``wedged`` (True only when a
+    :func:`run_bounded` watchdog abandoned a call that was STILL
+    RUNNING at expiry — the wedged-transport signature, as opposed to a
+    cooperative checkpoint noticing the budget gone). Pickle-safe
+    across the spawn pool (``__reduce__`` keeps every field)."""
+
+    def __init__(self, message: str = "", *, op: Optional[str] = None,
+                 budget_s: Optional[float] = None,
+                 elapsed_s: Optional[float] = None,
+                 index: Optional[int] = None, site: Optional[str] = None,
+                 wedged: bool = False):
+        super().__init__(message or "deadline exceeded")
+        self.op = op
+        self.budget_s = budget_s
+        self.elapsed_s = elapsed_s
+        self.index = index
+        self.site = site
+        self.wedged = wedged
+
+    def __reduce__(self):
+        return (_rebuild, (self.args, self.op, self.budget_s,
+                           self.elapsed_s, self.index, self.site,
+                           self.wedged))
+
+
+def _rebuild(args, op, budget_s, elapsed_s, index, site, wedged=False):
+    e = DeadlineExceeded(*args)
+    e.op, e.budget_s, e.elapsed_s = op, budget_s, elapsed_s
+    e.index, e.site, e.wedged = index, site, wedged
+    return e
+
+
+def default_timeout_s() -> Optional[float]:
+    """The process-wide default budget (``PYRUHVRO_TPU_DEADLINE_S``;
+    unset/empty/malformed = no default = unbounded)."""
+    raw = os.environ.get("PYRUHVRO_TPU_DEADLINE_S", "").strip()
+    if not raw:
+        return None
+    try:
+        v = float(raw)
+    except ValueError:
+        return None
+    return v if v >= 0 else None
+
+
+class _Deadline:
+    __slots__ = ("until", "budget_s", "op", "t0")
+
+    def __init__(self, until: float, budget_s: float, op: str):
+        self.until = until
+        self.budget_s = budget_s
+        self.op = op
+        self.t0 = time.monotonic()
+
+
+class scope:
+    """Open a deadline for the current call (thread-local). ``timeout_s``
+    None defers to the env default (no scope at all when that is unset
+    too); a nested scope takes the TIGHTER of its own and the enclosing
+    bound. Negative budgets are a caller error."""
+
+    __slots__ = ("_dl", "_prev")
+
+    def __init__(self, timeout_s: Optional[float], op: str = "call"):
+        if timeout_s is None:
+            timeout_s = default_timeout_s()
+        if timeout_s is not None and timeout_s < 0:
+            raise ValueError(f"timeout_s must be >= 0, got {timeout_s!r}")
+        self._dl: Optional[_Deadline] = None
+        if timeout_s is not None:
+            until = time.monotonic() + timeout_s
+            outer = getattr(_tls, "deadline", None)
+            if outer is not None:
+                until = min(until, outer.until)
+            self._dl = _Deadline(until, timeout_s, op)
+
+    def __enter__(self) -> "scope":
+        self._prev = getattr(_tls, "deadline", None)
+        if self._dl is not None:
+            _tls.deadline = self._dl
+        return self
+
+    def __exit__(self, *exc):
+        if self._dl is not None:
+            _tls.deadline = self._prev
+        return False
+
+
+def _current() -> Optional[_Deadline]:
+    return getattr(_tls, "deadline", None)
+
+
+def current() -> Optional[_Deadline]:
+    """The calling thread's open deadline (opaque handle for
+    :class:`attach`; None = unbounded)."""
+    return _current()
+
+
+class attach:
+    """Install an already-open deadline on THIS thread. Deadlines are
+    thread-local, so a fan-out worker thread starts unbounded; the pool
+    captures the submitting caller's :func:`current` handle and attaches
+    it around each chunk so ``check()`` fires inside workers too."""
+
+    __slots__ = ("_dl", "_prev")
+
+    def __init__(self, dl: Optional[_Deadline]):
+        self._dl = dl
+
+    def __enter__(self) -> "attach":
+        self._prev = getattr(_tls, "deadline", None)
+        if self._dl is not None:
+            _tls.deadline = self._dl
+        return self
+
+    def __exit__(self, *exc):
+        if self._dl is not None:
+            _tls.deadline = self._prev
+        return False
+
+
+def active() -> bool:
+    return _current() is not None
+
+
+def remaining() -> Optional[float]:
+    """Seconds left in the current budget (None = unbounded; never
+    negative — an expired deadline reads 0.0)."""
+    dl = _current()
+    if dl is None:
+        return None
+    return max(0.0, dl.until - time.monotonic())
+
+
+def _expired(dl: _Deadline, index: Optional[int],
+             site: Optional[str]) -> DeadlineExceeded:
+    from . import metrics
+
+    elapsed = time.monotonic() - dl.t0
+    metrics.inc("deadline.exceeded")
+    if site:
+        metrics.inc("deadline.exceeded." + site)
+    at = f" at record {index}" if index is not None else ""
+    return DeadlineExceeded(
+        f"{dl.op}: deadline of {dl.budget_s:g}s exceeded after "
+        f"{elapsed:.3f}s{at}" + (f" ({site})" if site else ""),
+        op=dl.op, budget_s=dl.budget_s, elapsed_s=round(elapsed, 6),
+        index=index, site=site,
+    )
+
+
+def check(index: Optional[int] = None, site: Optional[str] = None) -> None:
+    """Cooperative checkpoint: raise :class:`DeadlineExceeded` when the
+    current budget is spent. Free when no deadline is active (one TLS
+    read)."""
+    dl = _current()
+    if dl is None:
+        return
+    if time.monotonic() >= dl.until:
+        raise _expired(dl, index, site)
+
+
+def run_bounded(fn: Callable[[], Any], site: str,
+                grace_s: float = 0.25) -> Any:
+    """Run ``fn()`` bounded by the remaining budget — the generalized
+    ``ops/codec.py`` probe pattern for calls that cannot check
+    cooperatively (an XLA compile/launch into a possibly-wedged
+    transport). No active deadline = direct call, zero overhead.
+
+    With a deadline: ``fn`` runs on a daemon watchdog thread joined
+    with ``remaining + grace_s``; if it has not returned by then the
+    thread is abandoned (it cannot be killed — but the CALLER walks
+    away bounded, which is the contract) and :class:`DeadlineExceeded`
+    raises with ``wedged=True``; the device seam feeds that into its
+    breaker, which is also what bounds the abandoned-thread leak (once
+    open, auto-routed calls stop dispatching into the wedge). ``fn``'s
+    own exception re-raises on the caller thread.
+
+    Cost: one short-lived thread spawn+join (tens of µs) per bounded
+    call, paid only while a deadline is active and only at the device
+    seams (host-tier enforcement is purely cooperative — see the
+    ``deadline_overhead`` bench probe). A pooled/persistent watchdog
+    would not help: a wedged call permanently consumes its thread, so
+    reuse would hand later calls a poisoned pool."""
+    dl = _current()
+    if dl is None:
+        return fn()
+    budget = max(0.0, dl.until - time.monotonic())
+    if budget <= 0:
+        raise _expired(dl, None, site)
+    box: list = []
+
+    def run():
+        try:
+            box.append((True, fn()))
+        except BaseException as e:  # noqa: BLE001 — re-raised below
+            box.append((False, e))
+
+    t = threading.Thread(target=run, daemon=True,
+                         name=f"pyruhvro-deadline-{site}")
+    t.start()
+    t.join(budget + grace_s)
+    if not box:
+        # the call is STILL RUNNING — wedged-transport signature (vs
+        # the budget<=0 entry case above, which proves nothing about
+        # the seam); callers feed this into the seam's breaker
+        exc = _expired(dl, None, site)
+        exc.wedged = True
+        raise exc
+    ok, val = box[0]
+    if ok:
+        return val
+    raise val

@@ -271,6 +271,26 @@ class InstrumentedJit:
 
     # -- the observable call ------------------------------------------------
 
+    @staticmethod
+    def _bounded(fn, site: str):
+        """``deadline.run_bounded`` with the device seam's breaker fed:
+        a WEDGED expiry (the XLA call was still running when the
+        watchdog walked away — an abandoned thread pins its launch args
+        alive) is a backend failure, so it must open ``device_backend``
+        like any other call-time fault: otherwise every deadline-bounded
+        call re-dispatches into the wedge and leaks another thread. A
+        cooperative/entry expiry (budget spent before dispatch) proves
+        nothing about the backend and feeds nothing."""
+        from . import breaker, deadline
+
+        try:
+            return deadline.run_bounded(fn, site)
+        except deadline.DeadlineExceeded as e:
+            if e.wedged:
+                metrics.inc("device.wedged")
+                breaker.get("device_backend").record_failure()
+            raise
+
     def __call__(self, *args):
         if self._exe is None:
             with self._ilock:
@@ -281,20 +301,31 @@ class InstrumentedJit:
         return self._launch(args, count_family_launch=True)
 
     def _compile_and_run(self, args):
-        """The cache-miss path: explicit compile, then one launch."""
+        """The cache-miss path: explicit compile, then one launch. With
+        a deadline active the compile runs under the
+        :func:`..deadline.run_bounded` watchdog (the generalized
+        ``ops/codec.py`` probe pattern): a wedged backend costs the
+        caller its remaining budget, not forever."""
+        from . import deadline, faults
+
         metrics.inc("device.jit_cache.misses")
         if self.family:
             metrics.inc(self.family + ".compiles")
+        faults.fire("device_compile")
         t0 = time.perf_counter()
         exe = None
         try:
-            exe = self._jit.lower(*args).compile()
+            exe = self._bounded(
+                lambda: self._jit.lower(*args).compile(), "device_compile")
+        except deadline.DeadlineExceeded:
+            raise
         except Exception:
             exe = None
         if exe is None:
             # no AOT split on this callable/backend: the first call's
             # wall time (trace + compile + run) IS the compile figure
-            out = self._jit(*args)
+            out = self._bounded(lambda: self._jit(*args),
+                                "device_compile")
             out = self._block(out)
             dt = time.perf_counter() - t0
             telemetry.observe("device.compile_s", dt, kind=self.kind,
@@ -312,9 +343,21 @@ class InstrumentedJit:
         return self._launch(args)
 
     def _launch(self, args, count_family_launch: bool = False):
+        from . import deadline, faults
+
+        def dispatch():
+            # the chaos hook runs INSIDE the watchdog-bounded callable:
+            # a hang here wedges the dispatch exactly like a stuck
+            # transport would (abandoned thread, wedged=True expiry)
+            faults.fire("device_launch")
+            return self._exe(*args)
+
         t0 = time.perf_counter()
         try:
-            out = self._exe(*args)
+            # bounded dispatch when a deadline is active (DeadlineExceeded
+            # is a RuntimeError: it passes the TypeError/ValueError
+            # degrade filter below untouched)
+            out = self._bounded(dispatch, "device_launch")
         except (TypeError, ValueError):
             # ONLY the argument-signature/placement complaints an AOT
             # Compiled raises where plain jit would accept (e.g.
@@ -349,10 +392,15 @@ class InstrumentedJit:
         return out
 
     def _block(self, out):
+        from . import deadline
+
         if not sync_mode():
             return out
         try:
-            return self._jax.block_until_ready(out)
+            return self._bounded(
+                lambda: self._jax.block_until_ready(out), "device_block")
+        except deadline.DeadlineExceeded:
+            raise
         except Exception:
             return out
 

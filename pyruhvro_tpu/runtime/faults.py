@@ -1,0 +1,213 @@
+"""Deterministic fault injection at every degradation seam.
+
+The library grew a degradation seam per PR — native build → Python
+fallback (PR 2), device → host (seed), pool → thread (PR 3),
+profile/flight persistence best-effort (PR 6/7) — but none of them had
+ever been *exercised* under injected failure: the only way to know a
+fallback works was for production to break first. This module makes
+failure a first-class, reproducible input:
+
+``PYRUHVRO_TPU_FAULTS="site:kind:rate[:seed][,site2:kind:rate...]"``
+
+* ``site`` — a named injection point (see :data:`SITES`); every
+  degradation seam calls :func:`fire` with its site name.
+* ``kind`` — ``error`` (raise :class:`FaultInjected`), ``hang`` (sleep
+  ``PYRUHVRO_TPU_FAULT_HANG_S`` seconds, default 2.0 — long enough to
+  trip a deadline, short enough that nothing waits forever), or
+  ``exit`` (``os._exit(13)`` — worker-death simulation; only honored at
+  the ``pool_worker`` site, where a spawned process dies and the parent
+  must survive).
+* ``rate`` — fraction of calls injected, in (0, 1]. Injection is
+  **counter-based** (Bresenham: call ``k`` injects iff
+  ``floor(k*rate) > floor((k-1)*rate)``), not random — the same spec
+  over the same call sequence injects at exactly the same calls, which
+  is what makes a chaos cell replayable.
+* ``seed`` — optional integer phase shift of the counter (two runs with
+  different seeds inject at different positions in the sequence).
+
+Every injection counts ``fault.injected.<site>`` and annotates the
+current root span (``fault_injected=<site>``), so the flight recorder
+shows chaos runs for what they are. A malformed spec never breaks the
+process: bad entries count ``fault.config_error`` and are ignored.
+
+Production cost when the knob is unset: one ``os.environ.get`` + a
+string compare per seam call.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Dict, Optional, Tuple
+
+from . import metrics
+
+__all__ = [
+    "FaultInjected",
+    "SITES",
+    "fire",
+    "active",
+    "degradable",
+    "injected_count",
+    "reset",
+]
+
+
+def degradable(e: BaseException) -> bool:
+    """The ONE fault-domain taxonomy shared by every tier's degrade
+    seam (device → host in ``ops/codec``, native VM → pure-Python in
+    ``api``): backend/runtime faults justify serving the call from the
+    fallback path — RuntimeError (XlaRuntimeError and an injected
+    :class:`FaultInjected` both subclass it; a VM module bug), transport
+    OSErrors, OOM. Data errors (``MalformedAvro`` is a ValueError),
+    capacity conditions (``BatchTooLarge``, ``DeviceCapacityExceeded``)
+    and deadline expiries are CONTRACTS and must propagate."""
+    from . import deadline
+
+    return (isinstance(e, (RuntimeError, OSError, MemoryError))
+            and not isinstance(e, deadline.DeadlineExceeded))
+
+# the canonical seam registry — one name per degradation seam. fire()
+# accepts only these (typos in a chaos spec must be loud in review, not
+# silently never-firing), and the README table documents each one.
+SITES = (
+    "native_build",     # runtime/native/build.py: extension compile/load
+    "native_extract",   # hostpath/codec.py: fused Arrow-native encode lane
+    "vm_decode",        # hostpath/codec.py: the C++ VM decode call
+    "device_compile",   # device_obs.InstrumentedJit: lower().compile()
+    "device_launch",    # device_obs.InstrumentedJit: executable launch
+    "h2d",              # ops/decode.py: host->device transfer
+    "pool_worker",      # api._proc_*_task: inside a spawn-pool worker
+    "profile_save",     # costmodel.save_profile
+    "profile_load",     # costmodel.load_profile
+    "flight_dump",      # telemetry.flight_dump file write
+    "obs_handler",      # obs_server request handler
+    "slo_alert",        # slo alert_command hook
+)
+
+_KINDS = ("error", "hang", "exit")
+
+
+class FaultInjected(RuntimeError):
+    """An injected fault (never raised outside a chaos run). Pickle-safe
+    across the spawn pool: ``site`` survives ``__reduce__``."""
+
+    def __init__(self, site: str, message: Optional[str] = None):
+        super().__init__(message or f"injected fault at {site!r}")
+        self.site = site
+
+    def __reduce__(self):
+        return (_rebuild, (self.site, str(self)))
+
+
+def _rebuild(site: str, message: str) -> "FaultInjected":
+    return FaultInjected(site, message)
+
+
+def hang_seconds() -> float:
+    """Sleep length of the ``hang`` kind (``PYRUHVRO_TPU_FAULT_HANG_S``,
+    default 2.0 s). Bounded by design: a chaos hang exists to trip
+    deadlines and watchdogs, not to wedge the test harness."""
+    try:
+        return max(0.0, float(
+            os.environ.get("PYRUHVRO_TPU_FAULT_HANG_S", "") or 2.0))
+    except ValueError:
+        return 2.0
+
+
+_lock = threading.Lock()
+# parsed plan memo: (raw env string, {site: (kind, rate)})
+_plan_memo: Optional[Tuple[str, Dict[str, Tuple[str, float]]]] = None
+# per-site deterministic call counters (seed folds in as a phase shift)
+_counters: Dict[str, int] = {}
+
+
+def _parse(raw: str) -> Dict[str, Tuple[str, float]]:
+    """``site:kind:rate[:seed]`` comma list -> {site: (kind, rate)};
+    seeds are applied to the counters as a phase shift at parse time.
+    Malformed entries count ``fault.config_error`` and are dropped."""
+    plan: Dict[str, Tuple[str, float]] = {}
+    for item in raw.split(","):
+        item = item.strip()
+        if not item:
+            continue
+        parts = item.split(":")
+        try:
+            site, kind, rate = parts[0], parts[1], float(parts[2])
+            seed = int(parts[3]) if len(parts) > 3 else 0
+            if site not in SITES or kind not in _KINDS:
+                raise ValueError(item)
+            if not (0.0 < rate <= 1.0):
+                raise ValueError(item)
+        except (IndexError, ValueError):
+            metrics.inc("fault.config_error")
+            continue
+        plan[site] = (kind, rate)
+        if seed:
+            _counters[site] = seed
+    return plan
+
+
+def _plan() -> Dict[str, Tuple[str, float]]:
+    """The active injection plan (re-parsed when the env var changes, so
+    tests and the chaos harness can flip specs in-process)."""
+    global _plan_memo
+    raw = os.environ.get("PYRUHVRO_TPU_FAULTS", "")
+    memo = _plan_memo
+    if memo is not None and memo[0] == raw:
+        return memo[1]
+    with _lock:
+        if _plan_memo is None or _plan_memo[0] != raw:
+            _plan_memo = (raw, _parse(raw) if raw else {})
+        return _plan_memo[1]
+
+
+def active() -> bool:
+    """Is any fault spec configured? (Cheap: one env read.)"""
+    return bool(_plan())
+
+
+def fire(site: str) -> None:
+    """The seam hook: deterministically inject the configured fault for
+    ``site`` (no-op when no spec covers it). Raises
+    :class:`FaultInjected` for kind ``error``; sleeps for ``hang``;
+    ``os._exit(13)`` for ``exit`` (``pool_worker`` only — elsewhere it
+    degrades to ``error``, a library must never kill its host process).
+    """
+    plan = _plan()
+    if not plan:
+        return
+    assert site in SITES, f"unknown fault site {site!r}"
+    ent = plan.get(site)
+    if ent is None:
+        return
+    kind, rate = ent
+    with _lock:
+        k = _counters.get(site, 0) + 1
+        _counters[site] = k
+    if int(k * rate) <= int((k - 1) * rate):
+        return
+    metrics.inc("fault.injected." + site)
+    from . import telemetry
+
+    telemetry.annotate_root(fault_injected=site)
+    if kind == "hang":
+        time.sleep(hang_seconds())
+        return
+    if kind == "exit" and site == "pool_worker":
+        os._exit(13)
+    raise FaultInjected(site)
+
+
+def injected_count(site: str) -> float:
+    """Injections so far at ``site`` (from the counters snapshot)."""
+    return metrics.snapshot().get("fault.injected." + site, 0.0)
+
+
+def reset() -> None:
+    """Clear counters and the parsed-plan memo (test isolation)."""
+    global _plan_memo
+    with _lock:
+        _counters.clear()
+        _plan_memo = None

@@ -1,0 +1,41 @@
+"""Crash-safe JSON artifact writes (write-to-temp + atomic rename).
+
+Every JSON artifact the library leaves behind — flight dumps,
+``telemetry_snapshot.json``, ``ROUTING_PROFILE.json`` — is loaded by a
+LATER process (post-mortem tooling, the warm-start router, CI artifact
+consumers). A process killed mid-``json.dump`` must never leave a
+truncated file that poisons that load: all writers go through
+:func:`atomic_write_json`, which writes ``<path>.tmp<pid>`` and
+``os.replace``\\ s it into place — readers see the old complete file or
+the new complete file, never a torn one.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any
+
+__all__ = ["atomic_write_json"]
+
+
+def atomic_write_json(path: str, doc: Any, *, indent: int = 1,
+                      sort_keys: bool = False, default=str) -> str:
+    """Serialize ``doc`` to ``path`` atomically; returns ``path``.
+    Raises ``OSError``/``ValueError`` like a plain write would — the
+    caller decides whether persistence failure is fatal. The temp file
+    is cleaned up on failure."""
+    tmp = f"{path}.tmp{os.getpid()}"
+    try:
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump(doc, f, indent=indent, sort_keys=sort_keys,
+                      default=default)
+            f.write("\n")
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.remove(tmp)
+        except OSError:
+            pass
+        raise
+    return path

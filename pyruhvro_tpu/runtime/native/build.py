@@ -113,6 +113,15 @@ def _load(mod_name: str, src_file: str, prof: bool = False):
     ``prof=True`` builds/loads the profiled variant to a distinct cached
     file (``<mod>.prof<EXT_SUFFIX>``); both variants export the same
     module name, so either satisfies the PyInit lookup."""
+    from .. import faults
+
+    try:
+        # chaos seam: an injected build fault declines THIS load only
+        # (not memoized — the toolchain is not actually broken, so the
+        # build must come back once the fault spec clears)
+        faults.fire("native_build")
+    except faults.FaultInjected:
+        return None
     key = mod_name + ("@prof" if prof else "")
     if key in _modules:
         return _modules[key]

@@ -108,7 +108,7 @@ def health() -> Tuple[int, Dict[str, Any]]:
     serviceable facts (broken spawn pool, native tier unavailable)
     stay 200 — the process still answers calls — but are reported so
     a dashboard can alarm on them separately."""
-    from . import slo
+    from . import breaker, slo
     from .pool import process_available
 
     window = _health_window_s()
@@ -124,13 +124,21 @@ def health() -> Tuple[int, Dict[str, Any]]:
         "latency_drift": recent("latency_drift"),
         "slo_breach": bool(slo_breached),
     }
+    # non-closed circuit breakers are degradation facts: the process
+    # still answers (the degraded path serves), so they stay 200, but a
+    # dashboard can alarm on the seam being withheld
+    open_breakers = {name: b["state"]
+                     for name, b in breaker.snapshot_breakers().items()
+                     if b.get("state") != "closed"}
     degraded = {
         "spawn_pool_broken": not process_available(),
         "native_ext": _native_state(),
         "device_backend": _device_state(),
+        "breakers": open_breakers,
     }
     ready = not any(unhealthy.values())
     status = ("ok" if ready and not degraded["spawn_pool_broken"]
+              and not open_breakers
               else "degraded" if ready else "unhealthy")
     body: Dict[str, Any] = {
         "status": status,
@@ -168,6 +176,9 @@ class _Handler(BaseHTTPRequestHandler):
         snap_doc = self.server._static_snapshot  # type: ignore[attr-defined]
         try:
             metrics.inc("obs.requests")
+            from . import faults
+
+            faults.fire("obs_handler")  # chaos seam -> the 500 path below
             if path == "/metrics":
                 from . import telemetry
 

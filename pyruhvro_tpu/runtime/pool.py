@@ -18,6 +18,16 @@ each chunk result, so the parent's ``snapshot()`` and quarantine
 channel still cover 100% of the work — nothing is dropped on the
 process boundary.
 
+A broken spawn pool (workers that cannot start, a worker that died
+mid-chunk) no longer disables process fan-out for the process lifetime:
+the ``process_pool`` circuit breaker (:mod:`.breaker`) opens — every
+call degrades to the thread path immediately, without re-spawning
+doomed workers — and, after exponential backoff, admits ONE half-open
+probe fan-out; a probe that succeeds closes the breaker and the
+process arms return to the router. Deadline-bounded calls
+(:mod:`.deadline`) wait on fan-out futures with the remaining budget
+and cancel unstarted chunks on expiry.
+
 Either way, every chunk is accounted: the per-chunk span carries the
 chunk's row count and its counter deltas, and ``pool.worker_rows`` sums
 rows over all workers (thread or process), so a chunked call's snapshot
@@ -32,14 +42,13 @@ import time
 from concurrent.futures import ProcessPoolExecutor, ThreadPoolExecutor
 from typing import Callable, List, Optional, Sequence
 
-from . import metrics, telemetry
+from . import breaker, deadline, metrics, telemetry
 
 __all__ = ["get_pool", "map_chunks", "get_process_pool", "map_chunks_proc",
            "pool_mode", "process_available", "fanout_stats"]
 
 _pool = None
 _proc_pool = None
-_proc_broken = False
 _lock = threading.Lock()
 
 
@@ -50,10 +59,12 @@ def pool_mode() -> str:
 
 
 def process_available() -> bool:
-    """Can a process-pool arm still be offered? False once the spawn
-    pool broke (``map_chunks_proc`` self-disables it) — the router must
-    stop proposing an arm every attempt of which degrades."""
-    return not _proc_broken
+    """Can a process-pool arm still be offered? False while the
+    ``process_pool`` circuit breaker is OPEN (the spawn pool broke and
+    its backoff has not expired) — the router must stop proposing an
+    arm every attempt of which degrades. Half-open reads True: the next
+    fan-out is the recovery probe."""
+    return breaker.get("process_pool").allow()
 
 
 class fanout_stats:
@@ -147,6 +158,10 @@ def map_chunks(fn: Callable, chunks: Sequence,
     metrics.inc("pool.chunks", len(chunks))
 
     def run_one(i, chunk, stats=None, inline=False):
+        # cooperative deadline checkpoint: a fan-out whose budget is
+        # spent skips every not-yet-started chunk instead of running
+        # the whole tail to completion
+        deadline.check(site="pool.chunk")
         n = rows(chunk) if rows is not None else None
         attrs = {"chunk": i}
         if inline:
@@ -177,14 +192,79 @@ def map_chunks(fn: Callable, chunks: Sequence,
     # established position as direct children of the call span; the
     # pool.fanout_s span is a SIBLING summary carrying the efficiency
     parent = telemetry.current_span()
+    # deadlines are thread-local: hand the caller's budget to the worker
+    # threads so the per-chunk checkpoint fires there too
+    dl = deadline.current()
 
     with fanout_stats(len(chunks)) as stats:
         def run(i_chunk):
             i, chunk = i_chunk
-            with telemetry.attach(parent):
+            with telemetry.attach(parent), deadline.attach(dl):
                 return run_one(i, chunk, stats)
 
-        return list(get_pool().map(run, enumerate(chunks)))
+        futures = [get_pool().submit(run, ic) for ic in enumerate(chunks)]
+        return _gather(futures, site="pool.fanout")
+
+
+def _gather(futures: List, site: str) -> List:
+    """Collect fan-out futures in order. With a deadline active, each
+    wait is bounded by the REMAINING budget (+ a grace so a chunk that
+    checkpoints right at the edge still reports its own structured
+    expiry); on timeout the unstarted futures are cancelled
+    (``cancel_futures`` semantics — running chunks cannot be
+    interrupted, but the caller stops waiting) and a structured
+    :class:`..deadline.DeadlineExceeded` raises."""
+    from concurrent.futures import TimeoutError as _FutTimeout
+
+    try:
+        out = []
+        for fut in futures:
+            rem = deadline.remaining()
+            if rem is None:
+                out.append(fut.result())
+            else:
+                out.append(fut.result(timeout=rem + 0.5))
+        return out
+    except _FutTimeout as e:
+        if fut.done() and fut.exception(timeout=0) is e:
+            # the CHUNK raised a TimeoutError of its own (the builtin
+            # TimeoutError IS concurrent.futures.TimeoutError on
+            # 3.11+): that is a chunk failure, not a fan-out wait
+            # expiry — cancel the siblings and propagate it untouched
+            # instead of masking it behind a fabricated deadline error
+            for f in futures:
+                f.cancel()
+            raise
+        for f in futures:
+            f.cancel()
+        metrics.inc("deadline.cancelled_futures")
+        deadline.check(site=site)          # raises the structured error
+        raise deadline.DeadlineExceeded(   # unreachable safety net
+            f"{site}: fan-out wait timed out", site=site)
+    except BaseException:
+        for f in futures:
+            f.cancel()
+        raise
+
+
+# the fault-spec env vars shipped with every fan-out so the PARENT's
+# in-process spec flips reach long-lived spawned workers (which
+# inherited whatever the env said at spawn time — useless for a chaos
+# harness that flips specs between calls)
+_CHAOS_ENV_KEYS = ("PYRUHVRO_TPU_FAULTS", "PYRUHVRO_TPU_FAULT_HANG_S")
+
+
+def _run_with_chaos_env(task: Callable, env, payload):
+    """Worker-side shim: sync the chaos env vars to the parent's view,
+    then run the real task (module-level → picklable for spawn)."""
+    import os
+
+    for k, v in env.items():
+        if v:
+            os.environ[k] = v
+        else:
+            os.environ.pop(k, None)
+    return task(payload)
 
 
 def map_chunks_proc(task: Callable, payloads: Sequence,
@@ -195,30 +275,45 @@ def map_chunks_proc(task: Callable, payloads: Sequence,
     :class:`..telemetry.worker_scope` — each worker's counters and span
     tree are merged back here, so the parent snapshot covers the whole
     fan-out. Raises whatever the pool raises (pickling errors, a broken
-    pool): callers fall back to the thread path and count it. A BROKEN
-    pool (workers that cannot start, e.g. no importable __main__ for
-    spawn) is torn down and the mode disabled for the process, so every
-    later call falls back immediately instead of re-spawning doomed
-    workers — and a wedged executor cannot hang interpreter exit."""
+    pool): callers fall back to the thread path and count it.
+
+    A BROKEN pool (workers that cannot start, a worker that died
+    mid-chunk) is torn down and the ``process_pool`` breaker records
+    the failure — at its threshold (default 1) the breaker OPENS and
+    every later call degrades immediately instead of re-spawning doomed
+    workers. Unlike the old permanent latch, the breaker re-admits a
+    half-open probe fan-out after backoff; its success here closes the
+    breaker and the process arms return to the router. Deadline-bounded
+    calls wait with the remaining budget and cancel unstarted chunks on
+    expiry (the expiry fails a half-open probe — a pool that cannot
+    answer inside the budget has not proven itself — but never counts
+    against a CLOSED breaker: a slow fan-out is not a broken pool)."""
     from concurrent.futures.process import BrokenProcessPool
 
-    global _proc_pool, _proc_broken
-    if _proc_broken:
-        raise RuntimeError("process pool disabled after breakage")
+    global _proc_pool
+    br = breaker.get("process_pool")
+    if not br.acquire():
+        raise RuntimeError("process pool circuit open")
     metrics.inc("pool.proc_chunks", len(payloads))
     if len(payloads) > 1:
         metrics.inc("pool.proc_fanouts")
     try:
         with fanout_stats(len(payloads), pool="process") as stats:
-            futures = [get_process_pool().submit(task, p)
+            chaos_env = {k: os.environ.get(k, "")
+                         for k in _CHAOS_ENV_KEYS}
+            futures = [get_process_pool().submit(
+                           _run_with_chaos_env, task, chaos_env, p)
                        for p in payloads]
             # collect EVERY result before merging any worker telemetry:
             # a fan-out that dies midway (broken pool, a worker's
-            # poison-datum error) must leave the parent's counters and
-            # quarantine collector untouched — the caller retries on the
-            # thread path, and partial merges would double-count the
-            # retried work
-            results = [fut.result() for fut in futures]
+            # poison-datum error, a deadline expiry) must leave the
+            # parent's counters, quarantine collector and routing
+            # ledger untouched — the caller retries on the thread path
+            # (or surfaces the error), and partial merges would
+            # double-count the retried work. This is what makes a dead
+            # worker's surviving siblings publish their payloads
+            # exactly once or not at all.
+            results = _gather(futures, site="pool.proc_fanout")
             for _result, payload in results:
                 dur = ((payload or {}).get("span") or {}).get("dur_s")
                 if dur:
@@ -230,10 +325,31 @@ def map_chunks_proc(task: Callable, payloads: Sequence,
             n = rows(payloads[i]) if rows is not None else None
             if n is not None and not (payload or {}).get("rows"):
                 metrics.inc("pool.worker_rows", float(n))
+        br.record_success()
         return out
     except BrokenProcessPool:
         with _lock:
-            broken, _proc_pool, _proc_broken = _proc_pool, None, True
+            broken, _proc_pool = _proc_pool, None
         if broken is not None:
             broken.shutdown(wait=False, cancel_futures=True)
+        br.record_failure()
+        raise
+    except deadline.DeadlineExceeded:
+        # an expiry only judges the pool when it was the recovery probe
+        # (see docstring); a closed breaker records nothing
+        if br.state() == "half_open":
+            br.record_failure()
+        raise
+    except BaseException as e:
+        # non-infrastructure failures: a worker's structured data error
+        # (MalformedAvro) means workers spawned, ran and reported — the
+        # pool is HEALTHY, so it closes a probing breaker; anything
+        # else (pickling error, injected chaos) fails the probe but
+        # never opens a closed breaker (pre-breaker semantics)
+        from ..fallback.io import MalformedAvro
+
+        if isinstance(e, MalformedAvro):
+            br.record_success()
+        elif br.state() == "half_open":
+            br.record_failure()
         raise

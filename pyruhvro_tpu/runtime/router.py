@@ -39,7 +39,7 @@ import time
 from collections import deque
 from typing import Any, Dict, List, Optional, Tuple
 
-from . import costmodel, drift, metrics, sampling, telemetry
+from . import breaker, costmodel, deadline, drift, metrics, sampling, telemetry
 
 __all__ = [
     "RouteDecision",
@@ -159,14 +159,27 @@ def decide(entry, backend: str, n_rows: int, *, op: str, chunks: int,
     chosen, mode, reason, explore = static_arm, "static", reason_s, False
     if autotune:
         costmodel.arm_persistence()
+        count = costmodel.tick(schema, op, band)
+        rate = costmodel.explore_rate()
+        period = int(round(1.0 / rate)) if rate > 0 else 0
+        explore_tick = bool(period and count % period == 0)
         offered = dict(arms)
         if not proc_ok:
             # the static-arm seed can re-insert a */process arm even
-            # after the spawn pool self-disabled; never offer an arm
+            # after the spawn pool's breaker opened; never offer an arm
             # every attempt of which degrades to threads
             for a in [a for a in offered if a.endswith("/process")]:
                 if len(offered) > 1:
                     del offered[a]
+        elif breaker.get("process_pool").state() == "half_open":
+            # recovering spawn pool: half-open probes ride the explore
+            # schedule — greedy traffic stays on the proven arms, and
+            # the scheduled explore call (which favors the now-least-
+            # observed arm) is the one that probes the pool back in
+            for a in [a for a in offered if a.endswith("/process")]:
+                if not explore_tick and len(offered) > 1:
+                    del offered[a]
+                    metrics.inc("router.halfopen_defer")
         if costmodel.device_penalized(schema):
             # recompile storm: the guard's verdict is a hard penalty —
             # the device arm is not offered at all this window. Unless
@@ -182,12 +195,21 @@ def decide(entry, backend: str, n_rows: int, *, op: str, chunks: int,
         # regression ratio (costmodel.predict x arm_penalty), so the
         # greedy pick leaves it exactly when an alternative is
         # predicted cheaper even against the inflated figure
-        count = costmodel.tick(schema, op, band)
-        rate = costmodel.explore_rate()
-        period = int(round(1.0 / rate)) if rate > 0 else 0
+        rem = deadline.remaining()
+        if rem is not None:
+            # a deadline-bounded call skips arms already predicted to
+            # blow the remaining budget (kept only when NOTHING fits:
+            # the least-bad arm still serves, and the checkpoint layer
+            # bounds the damage)
+            over = [a for a in offered
+                    if predicted.get(a) is not None and predicted[a] > rem]
+            if over and len(over) < len(offered):
+                for a in over:
+                    del offered[a]
+                metrics.inc("router.deadline_skip", float(len(over)))
         known = {a: p for a, p in predicted.items()
                  if a in offered and p is not None}
-        if period and len(offered) > 1 and count % period == 0:
+        if explore_tick and len(offered) > 1:
             chosen = min(offered, key=lambda a: (
                 costmodel.obs_count(schema, op, band, a), a))
             mode, explore = "explore", True
@@ -260,6 +282,25 @@ def observe(dec: Optional[RouteDecision],
                           dt / dec.rows)
     elif error is not None:
         metrics.inc("router.call_error")
+        if isinstance(error, deadline.DeadlineExceeded):
+            # unlike other errors (which teach nothing about
+            # throughput), a blown deadline IS a cost observation: the
+            # arm spent at least the budget and delivered NOTHING. The
+            # elapsed wall seconds are capped at the budget though — a
+            # figure strictly BELOW the arm's true cost — so teaching
+            # them raw would make the failing arm look CHEAPER than an
+            # honest alternative (true cost 10s, budget 5s: every
+            # expiry records 5s and greedy keeps picking the arm that
+            # keeps blowing deadlines). Record an inflated lower bound
+            # instead: repeated expiries price the arm out, one real
+            # success re-teaches the true cost. A timeout_s=0 probe
+            # (budget 0, ~µs elapsed) teaches nothing — its near-zero
+            # figure would poison the estimate toward free.
+            metrics.inc("router.deadline_exceeded")
+            budget = getattr(error, "budget_s", None) or 0.0
+            if budget > 0:
+                costmodel.observe(dec.schema, dec.op, dec.band, dec.arm,
+                                  dec.rows, max(dt, budget) * 4.0)
     pred = dec.predicted.get(dec.arm)
     entry: Dict[str, Any] = {
         "ts": round(time.time(), 6),

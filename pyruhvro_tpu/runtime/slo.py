@@ -381,6 +381,9 @@ def _run_alert(o: _Objective, stats: List[Dict[str, Any]]) -> None:
     env["PYRUHVRO_SLO_BURN"] = str(
         stats[0]["burn_rate"] if stats else "")
     try:
+        from . import faults
+
+        faults.fire("slo_alert")  # chaos seam -> the counted-error path
         subprocess.Popen(
             o.alert_command, shell=True, env=env,
             stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,

@@ -188,7 +188,8 @@ def _hist(key: str) -> _Hist:
 class Span:
     """One timed node of a call tree (root = public API call)."""
 
-    __slots__ = ("name", "attrs", "children", "dur_s", "ts", "_t0")
+    __slots__ = ("name", "attrs", "children", "dur_s", "ts", "_t0",
+                 "parent")
 
     def __init__(self, name: str, attrs: Dict[str, Any]):
         self.name = name
@@ -197,6 +198,8 @@ class Span:
         self.dur_s: Optional[float] = None
         self.ts = time.time()
         self._t0 = time.perf_counter()
+        # up-link for annotate_root (not serialized; to_dict walks down)
+        self.parent: Optional["Span"] = None
 
     def to_dict(self) -> Dict[str, Any]:
         d: Dict[str, Any] = {
@@ -258,6 +261,7 @@ class root_span:
         if self._prev is not None:
             with _lock:
                 self._prev.children.append(s)
+            s.parent = self._prev
         _tls.span = s
         return s
 
@@ -319,6 +323,7 @@ class phase:
                 self.span = Span(self.key, self.attrs)
                 with _lock:
                     parent.children.append(self.span)
+                self.span.parent = parent
                 self._prev = parent
                 _tls.span = self.span
         self._t0 = time.perf_counter()
@@ -377,6 +382,19 @@ def annotate(**attrs) -> None:
     s = getattr(_tls, "span", None)
     if s is not None:
         s.attrs.update(attrs)
+
+
+def annotate_root(**attrs) -> None:
+    """Merge attributes into the ROOT of the current span tree (no-op
+    outside a span). For facts about the whole call — e.g. an injected
+    chaos fault — that must surface in the flight recorder's compact
+    per-call record even when detected deep inside a phase child."""
+    s = getattr(_tls, "span", None)
+    if s is None:
+        return
+    while s.parent is not None:
+        s = s.parent
+    s.attrs.update(attrs)
 
 
 def set_route(tier: str, reason: Optional[str] = None) -> None:
@@ -451,7 +469,11 @@ def _flight_records(blocking: bool = True) -> List[Dict[str, Any]]:
 
 def flight_dump(path: Optional[str] = None, *, blocking: bool = True):
     """The flight-recorder contents: as a dict (``path=None``) or
-    written to ``path`` as JSON (returns the path)."""
+    written to ``path`` as JSON (returns the path). File writes are
+    atomic (tmp + rename, :mod:`.fsio`): a process killed mid-dump can
+    never leave a truncated artifact for the post-mortem tooling."""
+    from . import faults, fsio
+
     records = _flight_records(blocking)
     doc = {
         "pid": os.getpid(),
@@ -460,9 +482,8 @@ def flight_dump(path: Optional[str] = None, *, blocking: bool = True):
     }
     if path is None:
         return doc
-    with open(path, "w", encoding="utf-8") as f:
-        json.dump(doc, f, indent=1, default=str)
-    return path
+    faults.fire("flight_dump")
+    return fsio.atomic_write_json(path, doc)
 
 
 def _flight_max_files() -> int:
@@ -542,9 +563,14 @@ def _flight_autodump(tag: str, blocking: bool = True) -> Optional[str]:
     _flight_last_auto = now
     _flight_seq += 1
     path = os.path.join(d, f"flight_{os.getpid()}_{_flight_seq}_{tag}.json")
+    from .faults import FaultInjected
+
     try:
         out = flight_dump(path, blocking=blocking)
-    except (OSError, ValueError):
+    except (OSError, ValueError, FaultInjected):
+        # a failed dump (incl. injected chaos) must never fail the call
+        # it observes
+        metrics.inc("flight.dump_error")
         return None
     _rotate_flight_dir(d, _flight_max_files(), counters=blocking)
     return out
@@ -739,6 +765,11 @@ def reset() -> None:
     sampling.reset()
     drift.reset()
     slo.reset()
+    # NOT breaker/faults: breaker state is OPERATIONAL (an open breaker
+    # must survive a snapshot reset — wiping it would silently re-admit
+    # a broken seam) and the fault-injection counters are the chaos
+    # harness's determinism anchor; tests isolate both explicitly
+    # (tests/conftest.py)
     with _trace_lock:
         if _trace_memo is not None:
             fh = _trace_memo[1]
@@ -804,6 +835,11 @@ def snapshot() -> Dict[str, Any]:
     dr = drift.snapshot_drift()
     if dr:
         out["drift"] = dr
+    from . import breaker
+
+    brs = breaker.snapshot_breakers()
+    if brs:
+        out["breakers"] = brs
     return out
 
 

@@ -1,0 +1,301 @@
+#!/usr/bin/env python
+"""Chaos soak: the fault matrix of tests/test_chaos.py scaled up and
+run as a standalone gate for the slow CI perf-artifacts job.
+
+Drives every (fault site x kind) cell through the public API under
+every on_error policy, with and without a per-call deadline, and
+asserts the ISSUE 8 invariants per cell:
+
+  * never a hang — the whole run sits under a faulthandler watchdog
+    and every bounded cell must return inside its budget + slack;
+  * never an interpreter crash — a fault either degrades or raises;
+  * correct output via a degraded path (byte-equal to the healthy
+    reference) or a structured error (FaultInjected / DeadlineExceeded
+    / MalformedAvro) — never silent corruption;
+  * recovery — after the spec clears, every breaker-owned seam
+    (native_extract, device_backend, process_pool) re-admits its arm
+    via the half-open probe.
+
+Each cell appends a record to the chaos ledger
+(``CHAOS_LEDGER.json``, atomic write) so CI uploads a replayable
+artifact: the spec string alone reproduces any cell (injection is
+counter-based, not random).
+
+Usage::
+
+    JAX_PLATFORMS=cpu python scripts/chaos_soak.py [--rounds N]
+        [--out CHAOS_LEDGER.json] [--skip-pool]
+
+Exit 1 on any invariant violation.
+"""
+
+from __future__ import annotations
+
+import argparse
+import faulthandler
+import json
+import os
+import sys
+import time
+import traceback
+
+sys.path.append(".")
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# a soak must see its own chaos clearly: short hangs, fast breakers
+os.environ.setdefault("PYRUHVRO_TPU_FAULT_HANG_S", "0.4")
+os.environ.setdefault("PYRUHVRO_TPU_BREAKER_BACKOFF", "0.1")
+
+WATCHDOG_S = 300  # any wedged cell dumps all stacks and kills the run
+
+DEV_SCHEMA = json.dumps({
+    "type": "record", "name": "ChaosSoak",
+    "fields": [
+        {"name": "a", "type": "long"},
+        {"name": "b", "type": "string"},
+    ],
+})
+
+
+def _spec(site: str, kind: str, rate: float = 1.0) -> str:
+    return f"{site}:{kind}:{rate:g}"
+
+
+class Cell:
+    """One matrix cell: run `fn` under `spec`, classify the outcome."""
+
+    def __init__(self, ledger, site, kind, op, policy, deadline_s=None):
+        self.ledger = ledger
+        self.rec = {
+            "site": site, "kind": kind, "op": op, "policy": policy,
+            "deadline_s": deadline_s,
+            "spec": _spec(site, kind),
+        }
+
+    def run(self, fn, check=None) -> bool:
+        from pyruhvro_tpu.fallback.io import MalformedAvro
+        from pyruhvro_tpu.runtime import faults, metrics
+        from pyruhvro_tpu.runtime.deadline import DeadlineExceeded
+        from pyruhvro_tpu.runtime.faults import FaultInjected
+
+        faults.reset()
+        os.environ["PYRUHVRO_TPU_FAULTS"] = self.rec["spec"]
+        budget = self.rec["deadline_s"]
+        t0 = time.monotonic()
+        ok, outcome, err = True, None, None
+        try:
+            out = fn()
+            outcome = "degraded_ok"
+            if check is not None and not check(out):
+                ok, outcome = False, "WRONG_OUTPUT"
+        except (FaultInjected, DeadlineExceeded, MalformedAvro) as e:
+            outcome = "structured_error"
+            err = type(e).__name__
+            if isinstance(e, DeadlineExceeded) and budget is None:
+                ok, outcome = False, "UNEXPECTED_DEADLINE"
+        except Exception as e:  # noqa: BLE001 — the invariant breaker
+            ok, outcome, err = False, "UNSTRUCTURED_ERROR", repr(e)
+            traceback.print_exc()
+        finally:
+            os.environ["PYRUHVRO_TPU_FAULTS"] = ""
+        dt = time.monotonic() - t0
+        # the no-hang invariant, per cell: a bounded call must return
+        # within budget + hang + generous slack
+        if budget is not None and dt > budget + 1.0 + 10.0:
+            ok, outcome = False, "OVERRAN_BUDGET"
+        self.rec.update({
+            "outcome": outcome, "error": err, "wall_s": round(dt, 4),
+            "injected": metrics.snapshot().get(
+                "fault.injected." + self.rec["site"], 0.0),
+            "pass": ok,
+        })
+        self.ledger.append(self.rec)
+        tag = "ok" if ok else "FAIL"
+        print(f"[{tag}] {self.rec['site']}:{self.rec['kind']} "
+              f"op={self.rec['op']} policy={self.rec['policy']} "
+              f"dl={budget} -> {outcome} ({dt:.2f}s)", flush=True)
+        return ok
+
+
+def _recover(name: str) -> bool:
+    """After the spec cleared: the named breaker must re-admit its seam
+    (closed already, or half-open and closable by the next probe)."""
+    from pyruhvro_tpu.runtime import breaker
+
+    br = breaker.get(name)
+    deadline_at = time.monotonic() + 10.0
+    while time.monotonic() < deadline_at:
+        if br.state() in ("closed", "half_open"):
+            return True
+        time.sleep(0.05)
+    print(f"[FAIL] breaker {name} stuck {br.state()} after fault cleared",
+          flush=True)
+    return False
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--rounds", type=int, default=3,
+                    help="matrix passes (default 3)")
+    ap.add_argument("--out", default="CHAOS_LEDGER.json")
+    ap.add_argument("--skip-pool", action="store_true",
+                    help="skip the spawn-pool worker-death leg")
+    args = ap.parse_args()
+
+    faulthandler.dump_traceback_later(WATCHDOG_S, exit=True)
+
+    import pyruhvro_tpu as p
+    from pyruhvro_tpu.hostpath import native_available
+    from pyruhvro_tpu.runtime import breaker, fsio, telemetry
+    from pyruhvro_tpu.schema.cache import get_or_parse_schema
+    from pyruhvro_tpu.utils.datagen import (
+        KAFKA_SCHEMA_JSON,
+        kafka_style_datums,
+        random_datums,
+    )
+
+    data = kafka_style_datums(400, seed=11)
+    bad = list(data)
+    for i in (7, 123, 300):
+        bad[i] = b"\xff\xff\xff"
+    dev_data = random_datums(get_or_parse_schema(DEV_SCHEMA).ir, 64,
+                             seed=11)
+    ref = p.deserialize_array(data, KAFKA_SCHEMA_JSON, backend="host")
+    ref_skip = p.deserialize_array(bad, KAFKA_SCHEMA_JSON, backend="host",
+                                   on_error="skip")
+    dev_ref = p.deserialize_array(dev_data, DEV_SCHEMA, backend="host")
+    [enc_ref] = p.serialize_record_batch(ref, KAFKA_SCHEMA_JSON, 1,
+                                         backend="host")
+
+    ledger: list = []
+    ok = True
+    for rnd in range(args.rounds):
+        print(f"--- round {rnd} ---", flush=True)
+        telemetry.reset()
+        for kind in ("error", "hang"):
+            dl = 2.0 if kind == "hang" else None
+            # native VM seam, every policy, decode + threaded decode
+            for policy in ("raise", "skip", "null"):
+                corpus, expect = (data, ref) if policy == "raise" \
+                    else (bad, None)
+                ok &= Cell(ledger, "vm_decode", kind, "decode", policy,
+                           dl).run(
+                    lambda c=corpus, po=policy, d=dl: p.deserialize_array(
+                        c, KAFKA_SCHEMA_JSON, backend="host", on_error=po,
+                        timeout_s=d),
+                    check=(lambda out, e=expect: out.equals(e))
+                    if expect is not None else
+                    (lambda out: out.num_rows in (ref_skip.num_rows,
+                                                  len(bad))))
+            ok &= Cell(ledger, "vm_decode", kind, "decode_threaded",
+                       "raise", dl).run(
+                lambda d=dl: p.deserialize_array_threaded(
+                    data, KAFKA_SCHEMA_JSON, 4, backend="host",
+                    timeout_s=d),
+                check=lambda out: sum(b.num_rows for b in out) == len(
+                    data))
+            # fused-extract encode seam
+            ok &= Cell(ledger, "native_extract", kind, "encode", "raise",
+                       dl).run(
+                lambda d=dl: p.serialize_record_batch(
+                    ref, KAFKA_SCHEMA_JSON, 1, backend="host",
+                    timeout_s=d)[0],
+                check=lambda out: out.equals(enc_ref))
+            ok &= _recover("native_extract")
+            # device seams degrade to host
+            for site in ("device_compile", "device_launch", "h2d"):
+                ok &= Cell(ledger, site, kind, "decode", "raise", dl).run(
+                    lambda d=dl: p.deserialize_array(
+                        dev_data, DEV_SCHEMA, backend="tpu", timeout_s=d),
+                    check=lambda out: out.equals(dev_ref))
+            ok &= _recover("device_backend")
+        # persistence / observability seams: counted, never call-fatal
+        from pyruhvro_tpu.runtime import costmodel
+
+        prof = os.path.join(os.getcwd(), f"_chaos_prof_{os.getpid()}.json")
+        try:
+            ok &= Cell(ledger, "profile_save", "error", "save_profile",
+                       "-").run(
+                lambda: costmodel.save_profile(prof),
+                check=lambda out: out is None)
+            ok &= Cell(ledger, "profile_load", "error", "load_profile",
+                       "-").run(
+                lambda: costmodel.load_profile(prof),
+                check=lambda out: out is False)
+        finally:
+            # save_profile leaves a flock sidecar next to the profile
+            for leftover in (prof, prof + ".lock"):
+                if os.path.exists(leftover):
+                    os.remove(leftover)
+        if native_available():
+            ok &= Cell(ledger, "native_build", "error", "decode",
+                       "raise").run(
+                lambda: p.deserialize_array(data, KAFKA_SCHEMA_JSON,
+                                            backend="host"),
+                check=lambda out: out.equals(ref))
+
+    if not args.skip_pool:
+        ok &= _pool_leg(ledger)
+
+    snap = {"breakers": breaker.snapshot_breakers()}
+    doc = {
+        "rounds": args.rounds,
+        "cells": len(ledger),
+        "failed": sum(1 for r in ledger if not r["pass"]),
+        "breakers_final": snap["breakers"],
+        "ledger": ledger,
+    }
+    fsio.atomic_write_json(args.out, doc)
+    print(f"chaos soak: {len(ledger)} cells, {doc['failed']} failed "
+          f"-> {args.out}", flush=True)
+    faulthandler.cancel_dump_traceback_later()
+    return 0 if ok and not doc["failed"] else 1
+
+
+def _pool_leg(ledger) -> bool:
+    """Worker-death leg: a spawn worker dies mid-fan-out (kind=exit),
+    the call degrades to threads, the process_pool breaker opens, and
+    after backoff the half-open probe re-admits real fan-outs."""
+    import pyruhvro_tpu as p
+    from pyruhvro_tpu.runtime import breaker, metrics, telemetry
+    from pyruhvro_tpu.utils.datagen import KAFKA_SCHEMA_JSON, \
+        kafka_style_datums
+
+    os.environ["PYRUHVRO_TPU_POOL"] = "process"
+    data = kafka_style_datums(200, seed=13)
+    telemetry.reset()
+    breaker.reset()
+    rec = {"site": "pool_worker", "kind": "exit", "op": "decode_threaded",
+           "policy": "raise", "spec": "pool_worker:exit:1"}
+    ok = True
+    try:
+        os.environ["PYRUHVRO_TPU_FAULTS"] = "pool_worker:exit:1"
+        out = p.deserialize_array_threaded(data, KAFKA_SCHEMA_JSON, 2,
+                                           backend="host")
+        assert sum(b.num_rows for b in out) == len(data)
+        assert breaker.get("process_pool").state() == "open", \
+            breaker.get("process_pool").state()
+        os.environ["PYRUHVRO_TPU_FAULTS"] = ""
+        time.sleep(0.3)  # backoff expires -> half-open
+        out = p.deserialize_array_threaded(data, KAFKA_SCHEMA_JSON, 2,
+                                           backend="host")
+        assert sum(b.num_rows for b in out) == len(data)
+        assert breaker.get("process_pool").state() == "closed", \
+            breaker.get("process_pool").state()
+        assert metrics.snapshot().get("pool.proc_chunks", 0) >= 2
+        rec.update({"outcome": "recovered", "pass": True})
+        print("[ok] pool_worker:exit -> degrade -> breaker reopen cycle",
+              flush=True)
+    except Exception as e:  # noqa: BLE001 — the invariant breaker
+        traceback.print_exc()
+        rec.update({"outcome": "FAILED", "error": repr(e), "pass": False})
+        ok = False
+    finally:
+        os.environ["PYRUHVRO_TPU_FAULTS"] = ""
+        os.environ.pop("PYRUHVRO_TPU_POOL", None)
+    ledger.append(rec)
+    return ok
+
+
+if __name__ == "__main__":
+    sys.exit(main())

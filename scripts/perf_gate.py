@@ -198,10 +198,9 @@ def save_snapshot(path: str) -> None:
     """The run's full telemetry snapshot — including the ``device``
     jit-cache/memory section when the device tier ran — as the gate's
     evidence artifact (CI exports it as a Perfetto trace too)."""
-    from pyruhvro_tpu.runtime import telemetry
+    from pyruhvro_tpu.runtime import fsio, telemetry
 
-    with open(path, "w", encoding="utf-8") as f:
-        json.dump(telemetry.snapshot(), f, indent=1, default=str)
+    fsio.atomic_write_json(path, telemetry.snapshot())
     _log(f"[perf-gate] telemetry snapshot -> {path}")
 
 
@@ -267,7 +266,7 @@ def route_matrix(args) -> int:
         deserialize_array_threaded,
         serialize_record_batch,
     )
-    from pyruhvro_tpu.runtime import costmodel, telemetry
+    from pyruhvro_tpu.runtime import costmodel, fsio, telemetry
     from pyruhvro_tpu.utils.datagen import KAFKA_SCHEMA_JSON as K
     from bench import _band, _gen_kafka  # noqa: E402
 
@@ -362,8 +361,7 @@ def route_matrix(args) -> int:
             _log(f"[route-matrix] {name} {key}: median "
                  f"{band['median_s'] * 1e3:.3f} ms (n={band['n']})")
     snap = telemetry.snapshot()
-    with open(snap_path, "w", encoding="utf-8") as f:
-        json.dump(snap, f, indent=1, default=str)
+    fsio.atomic_write_json(snap_path, snap)
     _log(f"[route-matrix] routing snapshot -> {snap_path}")
 
     # the ledger-coverage acceptance: every AUTOTUNED call carries an
@@ -461,9 +459,7 @@ def route_matrix(args) -> int:
         "verdicts": verdicts,
         "pass": not failed,
     }
-    with open(report_path, "w", encoding="utf-8") as f:
-        json.dump(report, f, indent=1, sort_keys=True)
-        f.write("\n")
+    fsio.atomic_write_json(report_path, report, sort_keys=True)
     _log(f"[route-matrix] report -> {report_path}")
     print(json.dumps({"metric": "route_matrix", "pass": not failed,
                       "ledger_coverage": round(coverage, 4),
@@ -589,9 +585,9 @@ def main(argv: Optional[list] = None) -> int:
             # evidence either way
             "device": _device_counters(),
         }
-        with open(args.baseline, "w", encoding="utf-8") as f:
-            json.dump(doc, f, indent=1, sort_keys=True)
-            f.write("\n")
+        from pyruhvro_tpu.runtime import fsio
+
+        fsio.atomic_write_json(args.baseline, doc, sort_keys=True)
         _log(f"[perf-gate] baseline reseeded -> {args.baseline}")
         return 0
 

@@ -30,11 +30,28 @@ def _telemetry_isolation():
     """Span/histogram/counter isolation between tests (ISSUE 1): no test
     observes telemetry produced by another. Imported lazily so the env
     pinning above still runs before anything touches JAX."""
-    from pyruhvro_tpu.runtime import telemetry
+    from pyruhvro_tpu.runtime import breaker, faults, telemetry
 
-    telemetry.reset()
+    def _reset():
+        telemetry.reset()
+        # breaker/fault state is operational and survives
+        # telemetry.reset() by design; tests still need a clean slate
+        breaker.reset()
+        faults.reset()
+
+    _reset()
     yield
-    telemetry.reset()
+    _reset()
+
+def pytest_collection_modifyitems(config, items):
+    # serial-marked tests are wall-clock-sensitive: when pytest-xdist is
+    # active, pin them all to one worker (loadgroup dist) so they never
+    # time themselves against a box saturated by sibling workers
+    if config.pluginmanager.hasplugin("xdist"):
+        for item in items:
+            if item.get_closest_marker("serial") is not None:
+                item.add_marker(pytest.mark.xdist_group("serial"))
+
 
 if not DEVICE_MODE:
     os.environ["JAX_PLATFORMS"] = "cpu"

@@ -1,0 +1,892 @@
+"""Chaos matrix (ISSUE 8): deterministic fault injection × API op ×
+on_error policy, per-call deadlines, and half-open breaker recovery.
+
+The cell invariants, asserted for every combination exercised here:
+
+* **never a hang** — the whole module runs under a per-test outer
+  watchdog (``faulthandler.dump_traceback_later``): a wedged cell dumps
+  every thread's stack and kills the process instead of wedging CI;
+* **never an interpreter crash** — a fault either degrades or raises;
+* **correct output via a degraded path, or a structured error**
+  (:class:`FaultInjected` / :class:`DeadlineExceeded` /
+  ``MalformedAvro``) — never silent corruption;
+* **the breaker re-admits the seam after the fault clears** — the
+  half-open probe measurably returns the arm (device and process pool
+  both, the ISSUE 8 acceptance).
+
+The process-pool cells spawn real workers (slow; the CI chaos job runs
+them, tier-1 skips ``-m slow`` as usual). Everything else runs on the
+spoofed 8-device CPU mesh.
+"""
+
+import faulthandler
+import json
+import os
+import pickle
+import subprocess
+import sys
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+import pyruhvro_tpu as p
+from pyruhvro_tpu.fallback.io import MalformedAvro
+from pyruhvro_tpu.hostpath import native_available
+from pyruhvro_tpu.runtime import (
+    breaker,
+    deadline,
+    faults,
+    metrics,
+    obs_server,
+    telemetry,
+)
+from pyruhvro_tpu.runtime.deadline import DeadlineExceeded
+from pyruhvro_tpu.runtime.faults import FaultInjected
+from pyruhvro_tpu.schema.cache import get_or_parse_schema
+from pyruhvro_tpu.utils.datagen import (
+    KAFKA_SCHEMA_JSON,
+    kafka_style_datums,
+    random_datums,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+NEED_NATIVE = pytest.mark.skipif(
+    not native_available(), reason="native host VM not built here")
+
+
+@pytest.fixture(autouse=True)
+def _outer_watchdog():
+    """The no-hang invariant, enforced: any cell that wedges for 120 s
+    dumps every thread's traceback and exits the interpreter non-zero —
+    a chaos run can fail, but it can never hang the harness."""
+    faulthandler.dump_traceback_later(120, exit=True)
+    yield
+    faulthandler.cancel_dump_traceback_later()
+
+
+@pytest.fixture
+def chaos(monkeypatch):
+    """Set/clear the fault spec in-process (the registry re-parses when
+    the env var changes; conftest's telemetry reset clears counters and
+    breakers between tests)."""
+
+    def set_spec(spec: str, hang_s: float = None):
+        monkeypatch.setenv("PYRUHVRO_TPU_FAULTS", spec)
+        if hang_s is not None:
+            monkeypatch.setenv("PYRUHVRO_TPU_FAULT_HANG_S", str(hang_s))
+
+    yield set_spec
+    monkeypatch.setenv("PYRUHVRO_TPU_FAULTS", "")
+
+
+def _dev_schema(doc: str) -> str:
+    """Device-subset schema with a unique doc → fresh SchemaEntry, cold
+    caches, no cross-test breaker/latch residue."""
+    return json.dumps({
+        "type": "record", "name": "Chaos", "doc": doc,
+        "fields": [
+            {"name": "a", "type": "long"},
+            {"name": "b", "type": "string"},
+        ],
+    })
+
+
+def _datums(schema: str, n: int, seed: int = 3):
+    return random_datums(get_or_parse_schema(schema).ir, n, seed=seed)
+
+
+def _corrupt(datums, bad=(5, 17)):
+    out = list(datums)
+    for i in bad:
+        out[i] = b"\xff\xff\xff"  # unterminated varints: reject on every tier
+    return out
+
+
+# ---------------------------------------------------------------------------
+# the registry itself: deterministic, reproducible, typo-loud
+# ---------------------------------------------------------------------------
+
+
+def test_fault_injection_is_counter_deterministic(chaos):
+    chaos("vm_decode:error:0.5")
+    hits = []
+    for k in range(10):
+        try:
+            faults.fire("vm_decode")
+            hits.append(False)
+        except FaultInjected:
+            hits.append(True)
+    assert sum(hits) == 5
+    pattern = list(hits)
+    faults.reset()
+    hits2 = []
+    for k in range(10):
+        try:
+            faults.fire("vm_decode")
+            hits2.append(False)
+        except FaultInjected:
+            hits2.append(True)
+    # same spec + same call sequence = same injection positions
+    assert hits2 == pattern
+    assert metrics.snapshot()["fault.injected.vm_decode"] == 10.0
+
+
+def test_fault_seed_shifts_the_injection_phase(chaos):
+    chaos("vm_decode:error:0.25")
+    base = []
+    for _ in range(8):
+        try:
+            faults.fire("vm_decode")
+            base.append(False)
+        except FaultInjected:
+            base.append(True)
+    faults.reset()
+    chaos("vm_decode:error:0.25:2")
+    shifted = []
+    for _ in range(8):
+        try:
+            faults.fire("vm_decode")
+            shifted.append(False)
+        except FaultInjected:
+            shifted.append(True)
+    assert sum(base) == sum(shifted) == 2
+    assert base != shifted
+
+
+def test_malformed_fault_spec_never_breaks_the_process(chaos):
+    chaos("nonsense:error:1,vm_decode:zap:1,vm_decode:error:7,:::,"
+          "vm_decode:error:0.5:notanint")
+    faults.fire("vm_decode")  # nothing valid parsed -> no-op
+    assert metrics.snapshot().get("fault.config_error", 0) >= 4
+    assert not faults.active()
+
+
+def test_every_site_fires_and_is_pickle_safe(chaos):
+    for site in faults.SITES:
+        faults.reset()
+        chaos(f"{site}:error:1")
+        with pytest.raises(FaultInjected) as ei:
+            faults.fire(site)
+        assert ei.value.site == site
+        back = pickle.loads(pickle.dumps(ei.value))
+        assert isinstance(back, FaultInjected) and back.site == site
+
+
+# ---------------------------------------------------------------------------
+# matrix: native-tier seams × policies → degraded-correct output
+# ---------------------------------------------------------------------------
+
+
+@NEED_NATIVE
+@pytest.mark.parametrize("on_error", ["raise", "skip", "null"])
+def test_vm_decode_fault_degrades_to_fallback_correctly(chaos, on_error):
+    """An injected VM fault must cost a tier, not the call: every policy
+    returns the same rows the healthy path would."""
+    data = kafka_style_datums(120, seed=7)
+    ref = p.deserialize_array(data, KAFKA_SCHEMA_JSON, backend="host")
+    telemetry.reset()
+    chaos("vm_decode:error:1")
+    out = p.deserialize_array(data, KAFKA_SCHEMA_JSON, backend="host",
+                              on_error=on_error)
+    assert out.equals(ref)
+    c = metrics.snapshot()
+    assert c.get("fault.injected.vm_decode", 0) >= 1, c
+    # the root span carries the chaos annotation for the flight recorder
+    spans = telemetry.snapshot()["spans"]
+    assert spans[-1]["attrs"].get("fault_injected") == "vm_decode"
+
+
+@NEED_NATIVE
+def test_vm_decode_fault_with_corrupt_rows_under_skip(chaos):
+    """Fault + poison together: the degraded path still applies the
+    policy — survivors byte-exact, quarantine indices global."""
+    data = _corrupt(kafka_style_datums(80, seed=9), bad=(5, 17))
+    ref = p.deserialize_array(data, KAFKA_SCHEMA_JSON, backend="host",
+                              on_error="skip")
+    telemetry.reset()
+    chaos("vm_decode:error:1")
+    out, errs = p.deserialize_array(
+        data, KAFKA_SCHEMA_JSON, backend="host", on_error="skip",
+        return_errors=True)
+    assert out.equals(ref)
+    assert sorted(e.index for e in errs) == [5, 17]
+
+
+@NEED_NATIVE
+def test_vm_decode_fault_threaded_fallback_chunks(chaos):
+    data = kafka_style_datums(200, seed=5)
+    ref = p.deserialize_array_threaded(data, KAFKA_SCHEMA_JSON, 4,
+                                       backend="host")
+    telemetry.reset()
+    chaos("vm_decode:error:1")
+    out = p.deserialize_array_threaded(data, KAFKA_SCHEMA_JSON, 4,
+                                       backend="host")
+    assert len(out) == len(ref)
+    assert all(a.equals(b) for a, b in zip(out, ref))
+    assert metrics.snapshot().get("route.native_failure", 0) >= 1
+
+
+@NEED_NATIVE
+def test_native_extract_fault_encode_parity_and_breaker_recovery(
+        chaos, monkeypatch):
+    """Encode: the fused C++ lane fails by injection → the Python
+    extractor serves byte-identical output; enough failures open the
+    ``native_extract`` breaker; after the fault clears, the half-open
+    probe re-admits the lane."""
+    monkeypatch.setenv("PYRUHVRO_TPU_BREAKER_BACKOFF", "0.05")
+    data = kafka_style_datums(100, seed=3)
+    batch = p.deserialize_array(data, KAFKA_SCHEMA_JSON, backend="host")
+    [ref] = p.serialize_record_batch(batch, KAFKA_SCHEMA_JSON, 1,
+                                     backend="host")
+    telemetry.reset()
+    chaos("native_extract:error:1")
+    br = breaker.get("native_extract")
+    for _ in range(br.threshold()):
+        [out] = p.serialize_record_batch(batch, KAFKA_SCHEMA_JSON, 1,
+                                         backend="host")
+        assert out.equals(ref)  # degraded lane, identical bytes
+    assert br.state() == "open"
+    c = metrics.snapshot()
+    assert c.get("extract.fallback_fault", 0) >= 1, c
+    assert c.get("breaker.native_extract.opened") == 1.0, c
+    # while open: the lane is withheld without paying the failure
+    [out] = p.serialize_record_batch(batch, KAFKA_SCHEMA_JSON, 1,
+                                     backend="host")
+    assert out.equals(ref)
+    assert metrics.snapshot().get("extract.breaker_open", 0) >= 1
+    # fault clears + backoff expires: the probe encode re-closes it
+    chaos("")
+    time.sleep(0.12)
+    [out] = p.serialize_record_batch(batch, KAFKA_SCHEMA_JSON, 1,
+                                     backend="host")
+    assert out.equals(ref)
+    assert br.state() == "closed"
+    assert metrics.snapshot().get("breaker.native_extract.closed") == 1.0
+
+
+def test_native_build_fault_serves_fallback_tier(chaos):
+    """A failed extension load is a degradation, not an outage — and not
+    a latch: the loader declines only while the spec is active."""
+    schema = _dev_schema("chaos-native-build")
+    data = _datums(schema, 40)
+    chaos("native_build:error:1")
+    ref = p.deserialize_array(data, schema, backend="host")
+    assert ref.num_rows == 40
+    assert metrics.snapshot().get("fault.injected.native_build", 0) >= 1
+    chaos("")
+
+
+# ---------------------------------------------------------------------------
+# matrix: device-tier seams → host fallback + breaker recovery
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("site", ["device_compile", "device_launch", "h2d"])
+def test_device_fault_degrades_to_host(chaos, site):
+    schema = _dev_schema(f"chaos-{site}")
+    data = _datums(schema, 48)
+    ref = p.deserialize_array(data, schema, backend="host")
+    telemetry.reset()
+    chaos(f"{site}:error:1")
+    out = p.deserialize_array(data, schema, backend="tpu")
+    assert out.equals(ref)
+    c = metrics.snapshot()
+    assert c.get(f"fault.injected.{site}", 0) >= 1, c
+    assert c.get("device.call_failure", 0) >= 1, c
+    chaos("")
+
+
+def test_device_breaker_opens_withholds_arm_then_readmits(
+        chaos, monkeypatch):
+    """The ISSUE 8 acceptance for the device seam: call-time failures
+    open the ``device_backend`` breaker (router stops offering the arm:
+    ``route.device_breaker_open``), and once the fault clears the
+    half-open probe returns the device path to service."""
+    monkeypatch.setenv("PYRUHVRO_TPU_BREAKER_BACKOFF", "0.05")
+    schema = _dev_schema("chaos-device-breaker")
+    data = _datums(schema, 48)
+    p.deserialize_array(data, schema, backend="tpu")  # warm compile
+    telemetry.reset()
+    chaos("device_launch:error:1")
+    out = p.deserialize_array(data, schema, backend="tpu")  # degrades
+    assert out.num_rows == 48
+    br = breaker.get("device_backend")
+    assert br.state() == "open"
+    # auto-routed calls now withhold the device arm outright
+    p.deserialize_array(data, schema, backend="auto")
+    assert metrics.snapshot().get("route.device_breaker_open", 0) >= 1
+    # healthz reports the open breaker as a degraded (not unhealthy) bit
+    code, body = obs_server.health()
+    assert code == 200
+    assert body["degraded_bits"]["breakers"].get("device_backend") == "open"
+    # fault clears, backoff expires: the next device call is the probe
+    # (no telemetry.reset() here — that would wipe the breaker registry
+    # and fake the recovery)
+    chaos("")
+    time.sleep(0.12)
+    pre = metrics.snapshot()
+    out = p.deserialize_array(data, schema, backend="tpu")
+    assert out.num_rows == 48
+    assert br.state() == "closed"
+    c = metrics.snapshot()
+    assert c.get("device.call_failure", 0) == pre.get(
+        "device.call_failure", 0), c  # the probe call paid no failure
+    assert c.get("device.launch_s", 0) > pre.get("device.launch_s", 0), c
+    # ...and the arm is back in the ledger for the probing call
+    led = telemetry.snapshot()["routing"]["ledger"][-1]
+    assert led["arm"].startswith("device/"), led
+
+
+def test_device_failure_memo_reprobe_per_schema_backoff(chaos, monkeypatch):
+    """The per-schema ``device_failure`` latch is no longer forever — it
+    retries on its own exponential backoff — and it is SCHEMA-SCOPED:
+    one schema whose device init keeps failing neither opens the shared
+    breaker nor starves other schemas of the device arm."""
+    import time as _t
+
+    schema = _dev_schema("chaos-memo-reprobe")
+    entry = get_or_parse_schema(schema)
+    from pyruhvro_tpu.api import _device_codec_ex
+
+    with entry._lock:
+        entry._extras["device_failure"] = "injected for test"
+        entry._extras["device_failure_opens"] = 1
+        entry._extras["device_failure_retry_at"] = _t.monotonic() + 60.0
+    codec, reason = _device_codec_ex(entry, "auto")
+    assert codec is None and reason == "device_failure_cached"
+    # schema-scoped: the shared breaker stays closed and a DIFFERENT
+    # schema still gets its device codec
+    assert breaker.get("device_backend").state() == "closed"
+    other = get_or_parse_schema(_dev_schema("chaos-memo-healthy"))
+    c2, r2 = _device_codec_ex(other, "auto")
+    assert c2 is not None, r2
+    # backoff expires -> the next call clears the latch and retries the
+    # construction; success forgets the schema's backoff history
+    with entry._lock:
+        entry._extras["device_failure_retry_at"] = _t.monotonic() - 0.01
+    codec, reason = _device_codec_ex(entry, "auto")
+    assert entry._extras.get("device_failure") is None
+    assert entry._extras.get("device_failure_opens") is None
+    assert codec is not None, reason
+    # an OPEN shared breaker (call-time failures elsewhere) withholds
+    # the schema's retry as well
+    with entry._lock:
+        entry._extras["device_failure"] = "again"
+        entry._extras["device_failure_retry_at"] = 0.0
+    breaker.get("device_backend").force_open(backoff_s=60.0)
+    codec, reason = _device_codec_ex(entry, "auto")
+    assert codec is None and reason == "device_failure_cached"
+
+
+# ---------------------------------------------------------------------------
+# matrix: persistence / observability seams — counted, never call-fatal
+# ---------------------------------------------------------------------------
+
+
+def test_profile_save_and_load_faults_are_cold_starts(chaos, tmp_path):
+    from pyruhvro_tpu.runtime import costmodel
+
+    path = str(tmp_path / "prof.json")
+    costmodel.observe("fp", "decode", 8, "native/c1/thread", 100, 0.01)
+    chaos("profile_save:error:1")
+    assert costmodel.save_profile(path) is None
+    assert metrics.snapshot().get("router.profile_save_error") == 1.0
+    chaos("")
+    assert costmodel.save_profile(path) == path
+    chaos("profile_load:error:1")
+    assert costmodel.load_profile(path) is False
+    assert metrics.snapshot().get("router.profile_load_error") == 1.0
+    chaos("")
+    assert costmodel.load_profile(path) is True
+
+
+def test_flight_dump_fault_never_fails_the_observed_call(
+        chaos, tmp_path, monkeypatch):
+    monkeypatch.setenv("PYRUHVRO_TPU_FLIGHT_DIR", str(tmp_path))
+    monkeypatch.setenv("PYRUHVRO_TPU_QUARANTINE_STORM", "2")
+    data = _corrupt(kafka_style_datums(40, seed=3), bad=(1, 2, 3))
+    chaos("flight_dump:error:1")
+    out = p.deserialize_array(data, KAFKA_SCHEMA_JSON, backend="host",
+                              on_error="skip")  # storm -> auto-dump -> fault
+    assert out.num_rows == 37
+    c = metrics.snapshot()
+    assert c.get("fault.injected.flight_dump", 0) >= 1, c
+    assert c.get("flight.dump_error", 0) >= 1, c
+    assert list(tmp_path.glob("*.json")) == []  # nothing half-written
+
+
+def test_obs_handler_fault_500s_but_server_survives(chaos):
+    srv = obs_server.ObsServer(port=0).start()
+    try:
+        chaos("obs_handler:error:1")
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(srv.url + "/metrics", timeout=10)
+        assert ei.value.code == 500
+        assert metrics.snapshot().get("obs.handler_error") == 1.0
+        chaos("")
+        with urllib.request.urlopen(srv.url + "/metrics", timeout=10) as r:
+            assert r.status == 200  # same server, next scrape fine
+    finally:
+        srv.stop()
+
+
+def test_slo_alert_fault_counts_error_and_call_survives(chaos):
+    from pyruhvro_tpu.runtime import slo
+
+    o = slo._Objective({
+        "name": "chaos-alert", "op": "decode", "threshold_s": 1e-9,
+        "target": 0.5, "windows_s": [1], "burn_threshold": 1.0,
+        "min_calls": 1, "alert_command": "true",
+    }, 0)
+    chaos("slo_alert:error:1")
+    slo._run_alert(o, [])
+    c = metrics.snapshot()
+    assert c.get("slo.alert_error") == 1.0, c
+    assert c.get("slo.alert_fired") is None, c
+
+
+# ---------------------------------------------------------------------------
+# deadlines: the per-call budget layer
+# ---------------------------------------------------------------------------
+
+
+def test_timeout_zero_probes_every_api_function():
+    """``timeout_s=0`` = "no budget at all": each of the five public
+    functions raises the structured expiry at its first checkpoint,
+    before any tier work."""
+    data = kafka_style_datums(10, seed=3)
+    batch = p.deserialize_array(data, KAFKA_SCHEMA_JSON, backend="host")
+    calls = [
+        ("deserialize_array",
+         lambda: p.deserialize_array(data, KAFKA_SCHEMA_JSON,
+                                     timeout_s=0)),
+        ("deserialize_array_threaded",
+         lambda: p.deserialize_array_threaded(data, KAFKA_SCHEMA_JSON, 2,
+                                              timeout_s=0)),
+        ("deserialize_array_threaded",
+         lambda: p.deserialize_array_threaded_spawn(
+             data, KAFKA_SCHEMA_JSON, 2, timeout_s=0)),
+        ("serialize_record_batch",
+         lambda: p.serialize_record_batch(batch, KAFKA_SCHEMA_JSON, 1,
+                                          timeout_s=0)),
+        ("serialize_record_batch",
+         lambda: p.serialize_record_batch_spawn(batch, KAFKA_SCHEMA_JSON,
+                                                1, timeout_s=0)),
+    ]
+    for op, call in calls:
+        with pytest.raises(DeadlineExceeded) as ei:
+            call()
+        e = ei.value
+        assert e.op == op and e.budget_s == 0 and e.site == "call_start"
+    assert metrics.snapshot().get("deadline.exceeded") == float(len(calls))
+
+
+def test_negative_timeout_is_a_caller_error():
+    with pytest.raises(ValueError):
+        p.deserialize_array(kafka_style_datums(5, seed=3),
+                            KAFKA_SCHEMA_JSON, timeout_s=-1)
+
+
+def test_deadline_env_default_applies(monkeypatch):
+    monkeypatch.setenv("PYRUHVRO_TPU_DEADLINE_S", "0")
+    with pytest.raises(DeadlineExceeded):
+        p.deserialize_array(kafka_style_datums(5, seed=3),
+                            KAFKA_SCHEMA_JSON)
+    # the kwarg wins over the env default
+    monkeypatch.setenv("PYRUHVRO_TPU_DEADLINE_S", "0")
+    out = p.deserialize_array(kafka_style_datums(5, seed=3),
+                              KAFKA_SCHEMA_JSON, backend="host",
+                              timeout_s=30)
+    assert out.num_rows == 5
+
+
+def test_deadline_exceeded_pickle_roundtrip():
+    e = DeadlineExceeded("decode: deadline of 1s exceeded", op="decode",
+                         budget_s=1.0, elapsed_s=1.25, index=42,
+                         site="pool.chunk", wedged=True)
+    back = pickle.loads(pickle.dumps(e))
+    assert isinstance(back, DeadlineExceeded)
+    assert (back.op, back.budget_s, back.elapsed_s, back.index,
+            back.site, back.wedged) == ("decode", 1.0, 1.25, 42,
+                                        "pool.chunk", True)
+    assert str(back) == str(e)
+
+
+@NEED_NATIVE
+def test_deadline_expiry_during_tolerant_resume(chaos):
+    """on_error="skip" + a hang fault: the budget outranks the salvage
+    loop — the structured expiry raises (a deadline is a call contract)
+    instead of the tolerant path absorbing the stall."""
+    data = _corrupt(kafka_style_datums(60, seed=3), bad=(10, 30))
+    chaos("vm_decode:hang:1", hang_s=0.3)
+    t0 = time.monotonic()
+    with pytest.raises(DeadlineExceeded) as ei:
+        p.deserialize_array(data, KAFKA_SCHEMA_JSON, backend="host",
+                            on_error="skip", timeout_s=0.15)
+    assert time.monotonic() - t0 < 5.0  # bounded, not the full salvage
+    assert ei.value.index is not None  # knows where it stopped
+
+
+@NEED_NATIVE
+def test_deadline_expiry_during_fanout_with_skip(chaos):
+    """Expiry during a thread-pool fan-out under on_error="skip": chunks
+    past the budget are skipped (cancelled or checkpoint-refused), the
+    structured error surfaces, futures do not leak."""
+    data = _corrupt(kafka_style_datums(240, seed=5), bad=(10, 200))
+    nchunks = 2 * (os.cpu_count() or 4) + 2
+    chaos("vm_decode:hang:1", hang_s=0.4)
+    with pytest.raises(DeadlineExceeded) as ei:
+        p.deserialize_array_threaded(
+            data, KAFKA_SCHEMA_JSON, nchunks, backend="host",
+            on_error="skip", timeout_s=0.15)
+    assert ei.value.site in ("pool.chunk", "pool.fanout", "host.chunk",
+                             "tolerant.resume", "host.vm"), ei.value.site
+    assert metrics.snapshot().get("deadline.exceeded", 0) >= 1
+
+
+def test_deadline_expiry_inside_capacity_ladder():
+    """Expiry inside a device capacity-ladder rung: the rung checkpoint
+    stops the climb with the ladder's own site tag."""
+    schema = json.dumps({
+        "type": "record", "name": "ChaosLadder",
+        "fields": [{"name": "xs",
+                    "type": {"type": "array", "items": "int"}}],
+    })
+    from pyruhvro_tpu.api import _device_codec
+    from pyruhvro_tpu.fallback.encoder import compile_writer
+
+    entry = get_or_parse_schema(schema)
+    w = compile_writer(entry.ir)
+
+    def arr_datums(n, items):
+        out = []
+        for _ in range(n):
+            buf = bytearray()
+            w(buf, {"xs": list(range(items))})
+            out.append(bytes(buf))
+        return out
+
+    p.deserialize_array(arr_datums(32, 2), schema, backend="tpu")  # tiny caps
+    codec = _device_codec(entry, "tpu")
+    assert codec is not None
+    with deadline.scope(0.005, op="ladder-test"):
+        time.sleep(0.02)  # burn the budget before the ladder starts
+        with pytest.raises(DeadlineExceeded) as ei:
+            codec.decode(arr_datums(32, 40))  # needs cap growth rungs
+    assert ei.value.site == "device.capacity_ladder"
+
+
+def test_device_launch_watchdog_bounds_a_wedged_dispatch(chaos):
+    """The generalized ops/codec.py probe pattern: a hang at the launch
+    seam costs the caller its remaining budget, not forever."""
+    schema = _dev_schema("chaos-launch-watchdog")
+    data = _datums(schema, 48)
+    p.deserialize_array(data, schema, backend="tpu")  # warm
+    chaos("device_launch:hang:1", hang_s=0.5)
+    t0 = time.monotonic()
+    with pytest.raises(DeadlineExceeded) as ei:
+        p.deserialize_array(data, schema, backend="tpu", timeout_s=0.1)
+    assert time.monotonic() - t0 < 5.0
+    assert ei.value.site == "device_launch"
+    # the watchdog walked away from a STILL-RUNNING dispatch: that is
+    # the wedged-transport signature, and it must open the device
+    # breaker (otherwise every bounded call re-dispatches into the
+    # wedge and leaks another abandoned thread)
+    assert ei.value.wedged is True
+    assert metrics.snapshot().get("device.wedged", 0) >= 1
+    assert breaker.get("device_backend").state() == "open"
+    chaos("")
+
+
+def test_router_skips_arms_predicted_over_the_remaining_budget(
+        monkeypatch):
+    """Deadline-aware routing: an arm whose predicted cost already blows
+    the remaining budget is not offered (unless nothing fits)."""
+    monkeypatch.setenv("PYRUHVRO_TPU_AUTOTUNE", "1")
+    monkeypatch.setenv("PYRUHVRO_TPU_EXPLORE", "0")
+    monkeypatch.setenv("PYRUHVRO_TPU_ROUTING_PROFILE", "")
+    from pyruhvro_tpu.runtime import costmodel, router
+
+    entry = get_or_parse_schema(_dev_schema("chaos-deadline-router"))
+    band = costmodel.row_band(1000)
+    slow = costmodel.arm_key("native", 4, "thread")
+    fast = costmodel.arm_key("fallback", 4, "thread")
+    for _ in range(4):
+        costmodel.observe(entry.fingerprint, "decode", band, slow, 1000,
+                          50.0)   # predicted 50 s -> over any sane budget
+        costmodel.observe(entry.fingerprint, "decode", band, fast, 1000,
+                          0.001)
+    cands = {"native": None, "fallback": None}
+    static = ("native", None, "static_native")
+    with deadline.scope(1.0, op="router-test"):
+        dec = router.decide(entry, "host", 1000, op="decode", chunks=4,
+                            candidates=cands, static=static)
+    assert dec.arm == fast
+    assert metrics.snapshot().get("router.deadline_skip", 0) >= 1
+
+
+def test_deadline_ledgered_and_taught_to_cost_model(chaos, monkeypatch):
+    """A blown budget is an error observation AND a cost observation:
+    the ledger entry carries the error, and the arm's estimate absorbs
+    the blown wall seconds."""
+    monkeypatch.setenv("PYRUHVRO_TPU_AUTOTUNE", "1")
+    from pyruhvro_tpu.runtime import costmodel
+
+    data = kafka_style_datums(50, seed=3)
+    with pytest.raises(DeadlineExceeded):
+        p.deserialize_array(data, KAFKA_SCHEMA_JSON, backend="host",
+                            timeout_s=0)
+    led = telemetry.snapshot()["routing"]["ledger"][-1]
+    assert led["error"] == "DeadlineExceeded", led
+    assert metrics.snapshot().get("router.call_error", 0) >= 1
+    # an expiry detected past the decision point teaches the arm
+    telemetry.reset()
+    entry = get_or_parse_schema(KAFKA_SCHEMA_JSON)
+    band = costmodel.row_band(len(data))
+    chaos("vm_decode:hang:1", hang_s=0.3)
+    with pytest.raises(DeadlineExceeded):
+        p.deserialize_array(
+            _corrupt(data, bad=(5,)), KAFKA_SCHEMA_JSON, backend="host",
+            on_error="skip", timeout_s=0.1)
+    chaos("")
+    assert metrics.snapshot().get("router.deadline_exceeded", 0) >= 1
+    led = telemetry.snapshot()["routing"]["ledger"][-1]
+    arm = led["arm"]
+    est = costmodel.predict(entry.fingerprint, "decode", band, arm,
+                            len(data))
+    if est is not None:  # the blown seconds priced the arm
+        assert est >= 0.1
+
+
+# ---------------------------------------------------------------------------
+# breaker unit behavior
+# ---------------------------------------------------------------------------
+
+
+def test_breaker_release_returns_probe_slot_without_verdict():
+    """A raising exit between acquire() and record_* must not wedge the
+    half-open probe slot for the TTL: release() hands it back with no
+    state change, so the next caller probes immediately."""
+    br = breaker.get("release-test")
+    br.force_open(backoff_s=0.0)
+    assert br.state() == "half_open"
+    assert br.acquire()       # probe slot consumed
+    assert not br.acquire()   # concurrent caller refused
+    br.release()              # raising exit delivered no verdict
+    assert br.state() == "half_open"
+    assert br.acquire()       # slot available again, no TTL wait
+    br.record_success()
+    assert br.state() == "closed"
+
+
+def test_breaker_state_machine_and_backoff_doubling(monkeypatch):
+    monkeypatch.delenv("PYRUHVRO_TPU_BREAKER_THRESHOLD", raising=False)
+    monkeypatch.delenv("PYRUHVRO_TPU_BREAKER_BACKOFF", raising=False)
+    br = breaker.CircuitBreaker("t", threshold=2, backoff_s=0.05)
+    assert br.state() == "closed" and br.allow()
+    br.record_failure()
+    assert br.state() == "closed"  # below threshold
+    br.record_failure()
+    assert br.state() == "open" and not br.allow()
+    assert not br.acquire()
+    time.sleep(0.07)
+    assert br.state() == "half_open"
+    assert br.acquire()        # exactly one probe
+    assert not br.acquire()    # concurrent caller refused
+    br.record_failure()        # failed probe -> re-open, doubled backoff
+    assert br.state() == "open"
+    assert br.export()["reopen_in_s"] > 0.05  # 2x base
+    time.sleep(0.22)
+    assert br.acquire()
+    br.record_success()
+    assert br.state() == "closed"
+    assert br.export()["opens"] == 0  # success resets the exponent
+
+
+def test_breaker_env_knobs_override(monkeypatch):
+    monkeypatch.setenv("PYRUHVRO_TPU_BREAKER_THRESHOLD", "5")
+    monkeypatch.setenv("PYRUHVRO_TPU_BREAKER_BACKOFF", "9.0")
+    br = breaker.CircuitBreaker("t2", threshold=1, backoff_s=0.01)
+    assert br.threshold() == 5
+    assert br.base_backoff_s() == 9.0
+    for _ in range(4):
+        br.record_failure()
+    assert br.state() == "closed"
+    br.record_failure()
+    assert br.state() == "open"
+
+
+def test_breaker_section_in_snapshot_and_healthz():
+    breaker.get("process_pool").force_open(backoff_s=60.0)
+    snap = telemetry.snapshot()
+    assert snap["breakers"]["process_pool"]["state"] == "open"
+    code, body = obs_server.health()
+    assert code == 200  # degraded, still serving
+    assert body["status"] == "degraded"
+    assert body["degraded_bits"]["spawn_pool_broken"] is True
+    assert body["degraded_bits"]["breakers"]["process_pool"] == "open"
+
+
+def test_open_process_breaker_degrades_thread_path_correctly():
+    data = kafka_style_datums(80, seed=3)
+    ref = p.deserialize_array_threaded(data, KAFKA_SCHEMA_JSON, 2,
+                                       backend="host")
+    breaker.get("process_pool").force_open(backoff_s=60.0)
+    out = p.deserialize_array_threaded(data, KAFKA_SCHEMA_JSON, 2,
+                                       backend="host")
+    assert all(a.equals(b) for a, b in zip(out, ref))
+    from pyruhvro_tpu.runtime.pool import process_available
+
+    assert process_available() is False
+
+
+# ---------------------------------------------------------------------------
+# spawn-pool cells: worker faults, exactly-once publish, recovery
+# (slow: real spawned interpreters; the CI chaos job runs these)
+# ---------------------------------------------------------------------------
+
+_POOL_CHAOS_SCRIPT = """
+import os, time
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["PYRUHVRO_TPU_POOL"] = "process"
+os.environ["PYRUHVRO_TPU_BREAKER_BACKOFF"] = "0.5"
+import pyruhvro_tpu as p
+from pyruhvro_tpu.runtime import breaker, metrics, telemetry
+from pyruhvro_tpu.schema.cache import get_or_parse_schema
+from pyruhvro_tpu.utils.datagen import random_datums
+
+SCHEMA = %r
+BAD = [5, 33]
+
+def corpus():
+    data = random_datums(get_or_parse_schema(SCHEMA).ir, 120, seed=11)
+    for i in BAD:
+        data[i] = b"\\xff\\xff\\xff"
+    return data
+
+def main():
+    data = corpus()
+    ref = p.deserialize_array(data, SCHEMA, backend="host",
+                              on_error="skip")
+
+    # A) worker-side FaultInjected (kind=error): the chunk error crosses
+    # the process boundary pickled, the thread path serves, and a worker
+    # APP error never opens the pool breaker (no failure double-count)
+    telemetry.reset()
+    os.environ["PYRUHVRO_TPU_FAULTS"] = "pool_worker:error:1"
+    out = p.deserialize_array_threaded(data, SCHEMA, 2, backend="host",
+                                       on_error="skip")
+    assert sum(b.num_rows for b in out) == 120 - len(BAD), out
+    c = metrics.snapshot()
+    assert c.get("pool.process_fallback") == 1, c
+    assert c.get("decode.quarantined") == len(BAD), c  # exactly once
+    assert breaker.get("process_pool").state() == "closed"
+    assert c.get("breaker.process_pool.opened") is None, c
+
+    # B) worker DEATH mid-fan-out (kind=exit): BrokenProcessPool ->
+    # breaker opens; thread path serves; quarantine still exactly once
+    telemetry.reset()
+    os.environ["PYRUHVRO_TPU_FAULTS"] = "pool_worker:exit:1"
+    out = p.deserialize_array_threaded(data, SCHEMA, 2, backend="host",
+                                       on_error="skip")
+    assert sum(b.num_rows for b in out) == 120 - len(BAD), out
+    c = metrics.snapshot()
+    assert c.get("pool.process_fallback") == 1, c
+    assert c.get("decode.quarantined") == len(BAD), c  # exactly once
+    assert breaker.get("process_pool").state() == "open"
+    assert c.get("breaker.process_pool.opened") == 1.0, c
+    opened_at = time.monotonic()
+
+    # C) while OPEN: immediate thread degrade, no fan-out attempted
+    os.environ["PYRUHVRO_TPU_FAULTS"] = ""
+    telemetry.reset()
+    out = p.deserialize_array_threaded(data, SCHEMA, 2, backend="host",
+                                       on_error="skip")
+    assert sum(b.num_rows for b in out) == 120 - len(BAD), out
+    c = metrics.snapshot()
+    assert c.get("pool.proc_chunks") is None, c   # never reached the pool
+    assert c.get("decode.quarantined") == len(BAD), c
+
+    # D) backoff expires -> half-open -> the next fan-out is the probe:
+    # clean workers close the breaker, the process arm serves again and
+    # the ledger shows it undegraded (ISSUE 8 acceptance)
+    time.sleep(max(0.0, 0.6 - (time.monotonic() - opened_at)))
+    telemetry.reset()
+    out = p.deserialize_array_threaded(data, SCHEMA, 2, backend="host",
+                                       on_error="skip")
+    assert sum(b.num_rows for b in out) == 120 - len(BAD), out
+    c = metrics.snapshot()
+    assert c.get("pool.proc_chunks") == 2, c      # real process fan-out
+    assert c.get("pool.process_fallback") is None, c
+    assert c.get("decode.quarantined") == len(BAD), c
+    assert breaker.get("process_pool").state() == "closed"
+    assert c.get("breaker.process_pool.closed") == 1.0, c
+    led = telemetry.snapshot()["routing"]["ledger"][-1]
+    assert led["pool"] == "process" and not led.get("degraded"), led
+    print("POOL-CHAOS-OK")
+
+if __name__ == "__main__":
+    main()
+""" % KAFKA_SCHEMA_JSON
+
+
+@pytest.mark.slow
+def test_pool_worker_chaos_breaker_lifecycle(tmp_path):
+    """Worker fault → thread degrade; worker death → breaker opens with
+    exactly-once quarantine publish; half-open probe fan-out re-admits
+    the process arm (run as a real script: spawn needs an importable
+    __main__)."""
+    script = tmp_path / "pool_chaos.py"
+    script.write_text(_POOL_CHAOS_SCRIPT)
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               PYTHONPATH=REPO + os.pathsep + os.environ.get(
+                   "PYTHONPATH", ""))
+    env.pop("PYRUHVRO_TPU_FAULTS", None)
+    r = subprocess.run([sys.executable, str(script)], capture_output=True,
+                       text=True, env=env, cwd=REPO, timeout=420)
+    assert r.returncode == 0, (r.stdout, r.stderr)
+    assert "POOL-CHAOS-OK" in r.stdout
+
+
+# ---------------------------------------------------------------------------
+# half-open probes ride the router's explore schedule
+# ---------------------------------------------------------------------------
+
+
+def test_halfopen_process_probes_ride_the_explore_schedule(monkeypatch):
+    """While the pool breaker is half-open, greedy calls keep the proven
+    arms (process arms deferred, counted) and only the scheduled explore
+    tick offers the probe."""
+    monkeypatch.setenv("PYRUHVRO_TPU_AUTOTUNE", "1")
+    monkeypatch.setenv("PYRUHVRO_TPU_EXPLORE", "0.25")
+    monkeypatch.setenv("PYRUHVRO_TPU_ROUTING_PROFILE", "")
+    from pyruhvro_tpu.runtime import costmodel, router
+
+    br = breaker.get("process_pool")
+    br.force_open(backoff_s=0.01)
+    time.sleep(0.05)
+    assert br.state() == "half_open"
+    entry = get_or_parse_schema(_dev_schema("chaos-halfopen-explore"))
+    band = costmodel.row_band(1000)
+    tarm = costmodel.arm_key("native", 4, "thread")
+    parm = costmodel.arm_key("native", 4, "process")
+    for _ in range(4):
+        costmodel.observe(entry.fingerprint, "decode", band, tarm, 1000,
+                          0.001)
+        costmodel.observe(entry.fingerprint, "decode", band, parm, 1000,
+                          0.0005)
+    cands = {"native": None}
+    static = ("native", None, "static_native")
+    picked = []
+    for _ in range(8):  # explore period = 4: two explore ticks in 8
+        dec = router.decide(entry, "host", 1000, op="decode", chunks=4,
+                            candidates=cands, static=static)
+        picked.append(dec.pool)
+    assert metrics.snapshot().get("router.halfopen_defer", 0) >= 1
+    # greedy traffic stayed off the recovering arm...
+    assert picked.count("process") <= 2
+    # ...but the explore tick did offer it (the probe path)
+    assert "process" in picked

@@ -298,10 +298,10 @@ def test_degraded_process_fanout_does_not_teach_the_model(monkeypatch):
     assert metrics.snapshot()["router.degraded"] == 1
 
 
-def test_broken_pool_drops_process_arms_from_offers(autotune, monkeypatch):
-    from pyruhvro_tpu.runtime import pool
+def test_broken_pool_drops_process_arms_from_offers(autotune):
+    from pyruhvro_tpu.runtime import breaker
 
-    monkeypatch.setattr(pool, "_proc_broken", True)
+    breaker.get("process_pool").force_open(backoff_s=60.0)
     entry = _entry()
     static, cands = _static_native(4)
     band = costmodel.row_band(1000)
