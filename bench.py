@@ -301,6 +301,26 @@ def _run_case(op, schema, datums, backend, chunks, reps, details,
         _log(f"[bench] {label or ''}{op}[{backend}] routing: "
              f"arm={routing['arm']} mode={routing['mode']} "
              f"pred={routing['predicted_s']} obs={routing['observed_s']}")
+    # fused wire→Arrow decode (ISSUE 9): hit rate of the one-pass C++
+    # assembly vs oracle fallbacks, and the vm/build split it moves —
+    # host.build_s is the Python-side residue (from_buffers walk when
+    # fused, the whole _Assembler when not)
+    fused_sec = None
+    f_hits = int(snap.get("decode.fused", 0))
+    f_fb = int(snap.get("decode.fused_fallback", 0))
+    if f_hits or f_fb:
+        fused_sec = {
+            "fused": f_hits,
+            "fallback": f_fb,
+            "hit_rate": round(f_hits / (f_hits + f_fb), 4),
+            "vm_s": round(snap.get("host.vm_s", 0.0), 6),
+            "build_s": round(snap.get("host.build_s", 0.0), 6),
+        }
+        _log(f"[bench] {label or ''}{op}[{backend}] fused decode: "
+             f"{f_hits} fused / {f_fb} fallback "
+             f"(hit rate {fused_sec['hit_rate'] * 100:.1f}%), "
+             f"vm {fused_sec['vm_s'] * 1e3:.2f} ms vs build "
+             f"{fused_sec['build_s'] * 1e3:.2f} ms over the case")
     # chunk fan-out efficiency (ISSUE 6 satellite): mean over the
     # case's fan-outs — 1.0 = chunks fully overlapped, 1/chunks =
     # serialized, absent = no fan-out happened (slice mode)
@@ -341,6 +361,7 @@ def _run_case(op, schema, datums, backend, chunks, reps, details,
         **({"routing": routing} if routing else {}),
         **({"pool": pool_sec} if pool_sec else {}),
         **({"sampling": samp_sec} if samp_sec else {}),
+        **({"fused_decode": fused_sec} if fused_sec else {}),
         "op": op, "backend": backend, "rows": rows, "chunks": chunks,
         "schema": label or "kafka", "seconds": dt, "records_per_s": rec_s,
         "vs_baseline": rec_s / base,
@@ -566,7 +587,22 @@ def main() -> None:
     # never label a CPU-backend number "tpu" (VERDICT r02: a host number
     # must not masquerade as the product number)
     dev_name = platform if use_device else "none"
-    headline = None  # (rec_s, name, rows)
+    headline = None  # (rec_s, name, rows, band, split)
+
+    def _last_split():
+        """The headline case's host vm/build split (+ fused hit rate)
+        — ISSUE 9: the headline line itself says where host time went."""
+        r = details["results"][-1]
+        m = r.get("metrics", {})
+        fd = r.get("fused_decode")
+        out = {}
+        if "host.vm_s" in m:
+            out["host_vm_s"] = m["host.vm_s"]
+        if "host.build_s" in m:
+            out["host_build_s"] = m["host.build_s"]
+        if fd:
+            out["fused_hit_rate"] = fd["hit_rate"]
+        return out or None
 
     def save_details():
         try:
@@ -587,7 +623,8 @@ def main() -> None:
                           args.chunks, args.reps, details)
         if rec_s and (headline is None or rec_s > headline[0]):
             headline = (rec_s, name, args.rows,
-                        details["results"][-1].get("band"))
+                        details["results"][-1].get("band"),
+                        _last_split())
         _run_case("serialize", kafka, datums, backend, args.chunks,
                   args.reps, details)
 
@@ -620,7 +657,7 @@ def main() -> None:
                 "metric": "deserialize_kafka_rec_s", "value": 0.0,
                 "unit": "records/s", "vs_baseline": 0.0,
             })
-        rec_s, name, rows, band = headline
+        rec_s, name, rows, band, split = headline
         return json.dumps({
             "metric": f"deserialize_kafka_{name}_{rows}rows",
             "value": round(rec_s, 1),
@@ -630,6 +667,9 @@ def main() -> None:
             # context (N reps, min and median wall seconds) instead of a
             # single unqualified number (VERDICT r05 weakness #6)
             "band": band,
+            # host vm-vs-build split + fused hit rate (ISSUE 9): the
+            # headline carries where its host time went
+            **({"host_split": split} if split else {}),
         })
 
     # phase ordering is wedge-aware (BENCH_NOTES.md): every HOST phase
@@ -658,7 +698,8 @@ def main() -> None:
             if (op == "deserialize" and rec_s
                     and (headline is None or rec_s > headline[0])):
                 headline = (rec_s, "host", args.north_star,
-                            details["results"][-1].get("band"))
+                            details["results"][-1].get("band"),
+                            _last_split())
         del ns
         save_details()
         print(_headline_line(), flush=True)
@@ -681,7 +722,8 @@ def main() -> None:
             name = dev_name if backend == "tpu" else "host"
             if rec_s and (headline is None or rec_s > headline[0]):
                 headline = (rec_s, name, args.big_rows,
-                            details["results"][-1].get("band"))
+                            details["results"][-1].get("band"),
+                            _last_split())
         del big
 
     save_details()

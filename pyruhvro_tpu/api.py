@@ -62,6 +62,7 @@ from .runtime import (
 )
 from .runtime.deadline import DeadlineExceeded
 from .runtime.chunking import bounds_rows, chunk_bounds
+from .runtime.ingest import as_datum_input
 from .runtime.pool import map_chunks, map_chunks_proc
 from .schema.cache import SchemaEntry, get_or_parse_schema
 
@@ -417,6 +418,20 @@ def _enforce_max_datum(data) -> None:
     paths on every tier. Free when the knob is unset (one env read)."""
     limit = max_datum_bytes()
     if not limit:
+        return
+    if hasattr(data, "lens"):
+        # arrow-ingested datums: screen the offsets diff vectorized
+        # instead of materializing ten million bytes objects
+        lens = data.lens()
+        if len(lens) and int(lens.max()) > limit:
+            import numpy as np
+
+            j = int(np.argmax(lens > limit))
+            raise MalformedAvro(
+                f"record {j}: datum of {int(lens[j])} bytes exceeds "
+                f"PYRUHVRO_TPU_MAX_DATUM_BYTES={limit}",
+                index=j, err_name="datum_too_large", tier="policy",
+            )
         return
     for j, d in enumerate(data):
         if len(d) > limit:
@@ -785,9 +800,16 @@ def deserialize_array(
     structured :class:`DeadlineExceeded` regardless of ``on_error``
     (a deadline is a call contract, not a data error). ``None`` defers
     to ``PYRUHVRO_TPU_DEADLINE_S``; ``0`` expires at the first
-    checkpoint (the "would this call have blocked?" probe)."""
+    checkpoint (the "would this call have blocked?" probe).
+
+    ``data`` may also be a pyarrow ``BinaryArray``/``LargeBinaryArray``
+    (or ``ChunkedArray`` of either) of datums — the shape
+    :func:`serialize_record_batch` returns — in which case the native
+    tier reads the array's offsets+data buffers directly (zero-copy
+    ingestion lane; no per-datum Python object is created)."""
     _check_backend(backend)
     _check_on_error(on_error)
+    data = as_datum_input(data)
     entry = get_or_parse_schema(schema)
     with telemetry.root_span("api.deserialize_array", rows=len(data),
                              backend=backend, schema=entry.fingerprint), \
@@ -859,13 +881,15 @@ def deserialize_array_threaded(
     (``parallel/sharded.py``); on a single chip the whole input is
     decoded in one fused launch and sliced per chunk.
 
-    ``on_error``/``return_errors``/``timeout_s``: see
+    ``on_error``/``return_errors``/``timeout_s`` and the pyarrow
+    BinaryArray ingestion lane for ``data``: see
     :func:`deserialize_array`.
     Chunk boundaries are computed on the INPUT rows; under ``"skip"``
     a chunk's batch holds its surviving rows (``"null"`` preserves the
     per-chunk row count on all-nullable schemas)."""
     _check_backend(backend)
     _check_on_error(on_error)
+    data = as_datum_input(data)
     entry = get_or_parse_schema(schema)
     bounds = chunk_bounds(len(data), num_chunks)
     with telemetry.root_span("api.deserialize_array_threaded",

@@ -147,9 +147,20 @@ class NativeHostCodec:
                nthreads: int = 0, index_base: int = 0) -> pa.RecordBatch:
         """``index_base`` offsets error-message record indices so the
         per-chunk mode of :meth:`decode_threaded` still reports the
-        GLOBAL position of a malformed datum."""
-        from ..ops.arrow_build import build_record_batch
-        from ..runtime import telemetry
+        GLOBAL position of a malformed datum.
+
+        ``data`` is a sequence of bytes-likes or a
+        :class:`..runtime.ingest.DatumView` (a pyarrow Binary/
+        LargeBinaryArray): the latter ships its offsets+values buffers
+        to the VM directly — zero per-datum Python objects on the
+        ingest boundary."""
+        import os
+
+        from ..ops.arrow_build import (
+            build_fused_record_batch,
+            build_record_batch,
+        )
+        from ..runtime import metrics, telemetry
 
         n = len(data)
         # adaptive deep sampling (runtime/sampling.py): a sampled call
@@ -172,25 +183,50 @@ class NativeHostCodec:
 
             deadline.check(index=index_base, site="host.vm")
             faults.fire("vm_decode")
-            # records decode straight from the caller's bytes objects (span
-            # collection in C++, ≙ extract_bytes_list src/lib.rs:29-33) —
-            # no concatenation pass exists on this path at all
+            # records decode straight from the caller's bytes objects
+            # (span collection in C++, ≙ extract_bytes_list
+            # src/lib.rs:29-33) or straight from a pyarrow array's own
+            # buffers — no concatenation pass exists on this path at all
+            native_data = (
+                data.native_parts() if hasattr(data, "native_parts")
+                else data
+            )
+            # the serving engine: deep-sampled prof build > specialized
+            # straight-line module > generic interpreter — each offers
+            # the fused wire→Arrow entry unless the knob pins the
+            # oracle (or a stale .so predates it)
+            if deep_mod is not None:
+                eng, generic = deep_mod, True
+            elif self._spec is not None:
+                eng, generic = self._spec, False
+            else:
+                eng, generic = self._mod, True
+            fused = None
+            if os.environ.get("PYRUHVRO_TPU_NO_FUSED_DECODE") != "1":
+                fused = getattr(eng, "decode_arrow", None)
             with telemetry.phase("host.vm_s",
                                  specialized=(self._spec is not None
-                                              and deep_mod is None)):
-                if deep_mod is not None:
-                    bufs, err_rec, err_bits = deep_mod.decode(
-                        self.prog.ops, self.prog.coltypes, data,
+                                              and deep_mod is None),
+                                 fused=fused is not None):
+                if fused is not None:
+                    if generic:
+                        payload, err_rec, err_bits = fused(
+                            self.prog.ops, self.prog.coltypes,
+                            self.prog.op_aux, native_data,
+                            _vm_threads(nthreads),
+                        )
+                    else:
+                        payload, err_rec, err_bits = fused(
+                            self.prog.coltypes, native_data, nthreads
+                        )
+                elif generic:
+                    payload, err_rec, err_bits = eng.decode(
+                        self.prog.ops, self.prog.coltypes, native_data,
                         _vm_threads(nthreads)
-                    )
-                elif self._spec is not None:
-                    bufs, err_rec, err_bits = self._spec.decode(
-                        self.prog.coltypes, data, nthreads
                     )
                 else:
-                    bufs, err_rec, err_bits = self._mod.decode(
-                        self.prog.ops, self.prog.coltypes, data,
-                        _vm_threads(nthreads)
+                    payload, err_rec, err_bits = eng.decode(
+                        self.prog.coltypes, native_data, nthreads
                     )
             if self._prof:
                 _drain_native_prof(self._mod)
@@ -208,6 +244,23 @@ class NativeHostCodec:
                     err_name=ERR_SLUGS.get(bit, f"bit_{bit:#x}"),
                     tier="native",
                 )
+            if fused is not None:
+                tag, body = payload
+                if tag == "arrow":
+                    # the hot lane: every buffer already in Arrow
+                    # layout — assembly is pure from_buffers composition
+                    metrics.inc("decode.fused")
+                    with telemetry.phase("host.build_s", fused=True):
+                        return build_fused_record_batch(
+                            self.ir, self.arrow_schema, body, n
+                        )
+                # the native pass declined (exotic value/shape — or a
+                # data condition whose error the oracle words): the
+                # plan buffers flow into the differential oracle below
+                metrics.inc("decode.fused_fallback")
+                bufs = body
+            else:
+                bufs = payload
             host = {}
             for (key, dt, _region), b in zip(self._plan, bufs):
                 host[key] = np.frombuffer(b, dtype=dt)
@@ -220,7 +273,7 @@ class NativeHostCodec:
             # string values travel in-VM (#bytes); the assembler's flat-
             # buffer gather path is never taken on this backend
             meta = {"item_totals": item_totals, "flat": np.zeros(0, np.uint8)}
-            with telemetry.phase("host.build_s"):
+            with telemetry.phase("host.build_s", fused=False):
                 return build_record_batch(
                     self.ir, self.arrow_schema, host, n, meta
                 )
@@ -299,14 +352,20 @@ class NativeHostCodec:
         return self._extract_mod
 
     @staticmethod
-    def _wrap_blob(blob, sizes, n: int) -> pa.Array:
-        from ..ops.arrow_build import cumsum0
+    def _wrap_blob(blob, offs, n: int) -> pa.Array:
+        """Wrap the native encode's return — ``offs`` now arrives as
+        the finished Arrow offsets buffer (n+1 int32, leading 0, built
+        inside the encode loop itself: ISSUE 9 satellite), so this is
+        two zero-copy ``py_buffer`` wraps. A stale pre-offsets ``.so``
+        still ships n sizes; its prefix sum runs here (counted by
+        length, never guessed)."""
+        if len(offs) != (n + 1) * 4:
+            from ..ops.arrow_build import cumsum0
 
-        sizes = np.frombuffer(sizes, np.int32)
-        offsets = cumsum0(sizes)  # VM bounds the total to int32
+            offs = cumsum0(np.frombuffer(offs, np.int32))
         return pa.Array.from_buffers(
             pa.binary(), n,
-            [None, pa.py_buffer(offsets),
+            [None, pa.py_buffer(offs),
              pa.py_buffer(np.frombuffer(blob, np.uint8))],
         )
 
@@ -425,14 +484,14 @@ class NativeHostCodec:
                 self._extract_declined_schema = batch.schema
             br.record_success()
             return None
-        blob, sizes, t_ex, t_enc = res
+        blob, offs, t_ex, t_enc = res
         br.record_success()
         telemetry.observe("host.extract_s", t_ex, rows=n, native=True)
         telemetry.observe("host.extract_native_s", t_ex, rows=n)
         telemetry.observe("host.encode_vm_s", t_enc, fused=True,
                           specialized=spec is not None)
         metrics.inc("extract.native")
-        return self._wrap_blob(blob, sizes, n)
+        return self._wrap_blob(blob, offs, n)
 
     def _encode_buffers(self, ex) -> List[np.ndarray]:
         """Map the shared Arrow extractor's per-path arrays
@@ -515,12 +574,12 @@ class NativeHostCodec:
             with telemetry.phase("host.encode_vm_s",
                                  specialized=self._spec is not None):
                 if self._spec is not None:
-                    blob, sizes = self._spec.encode(
+                    blob, offs = self._spec.encode(
                         self.prog.coltypes, bufs, n, hint, checked
                     )
                 else:
                     try:
-                        blob, sizes = self._mod.encode(
+                        blob, offs = self._mod.encode(
                             self.prog.ops, self.prog.coltypes, bufs, n,
                             hint, checked
                         )
@@ -537,7 +596,7 @@ class NativeHostCodec:
                             ) from None
                         # stale pre-hint .so (build.py keeps a usable old
                         # binary when rebuild fails): 4-arg form
-                        blob, sizes = self._mod.encode(
+                        blob, offs = self._mod.encode(
                             self.prog.ops, self.prog.coltypes, bufs, n
                         )
         except OverflowError as ex:
@@ -547,7 +606,7 @@ class NativeHostCodec:
             raise BatchTooLarge(n, -1)
         if self._prof:
             _drain_native_prof(self._mod)
-        return self._wrap_blob(blob, sizes, n)
+        return self._wrap_blob(blob, offs, n)
 
     def encode_threaded(self, batch: pa.RecordBatch,
                         num_chunks: int) -> List[pa.Array]:

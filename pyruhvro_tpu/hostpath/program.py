@@ -75,9 +75,10 @@ class HostProgram:
     regions: List[str]         # region id -> repeated-field path
     region_parents: List[int]
     # per-op logical facts the flat opcode table cannot carry, shaped
-    # for the Arrow-native extractor (runtime/native/extract_core.h):
-    # one entry per op — None, ("uuid",), ("duration",) or
-    # ("enum", symbol_bytes, ...)
+    # for the Arrow-native extractor AND the fused Arrow decoder
+    # (runtime/native/extract_core.h / arrow_decode_core.h): one entry
+    # per op — None, ("uuid",), ("binary",), ("duration",),
+    # ("decimal", precision) or ("enum", symbol_bytes, ...)
     op_aux: tuple = ()
 
     def buffer_plan(self) -> List[Tuple[str, object, int]]:
@@ -144,20 +145,27 @@ class _HostLowering:
             elif name == "bytes":
                 if t.logical == "decimal":
                     # wire: length-prefixed big-endian two's complement;
-                    # column: 16-byte LE decimal128 words
-                    self.emit(OP_DEC_BYTES,
-                              col=self.col(path + "#dec", COL_U8, region))
+                    # column: 16-byte LE decimal128 words (the aux tag
+                    # carries the declared precision for the fused
+                    # decoder's native range check)
+                    i = self.emit(OP_DEC_BYTES,
+                                  col=self.col(path + "#dec", COL_U8,
+                                               region))
+                    self.aux[i] = ("decimal", t.precision)
                 else:
                     # same wire form and builder as string; only the
-                    # Arrow assembly differs (Binary, no UTF-8 check)
-                    self.emit(OP_STRING,
-                              col=self.col(path, COL_STR, region))
+                    # Arrow assembly differs (Binary, no UTF-8 check —
+                    # the aux tag tells the fused decoder to skip it)
+                    i = self.emit(OP_STRING,
+                                  col=self.col(path, COL_STR, region))
+                    self.aux[i] = ("binary",)
             else:  # pragma: no cover — gated by host_supported
                 raise UnsupportedOnDevice(f"primitive {name!r} at {path!r}")
         elif isinstance(t, Fixed):
             if t.logical == "decimal":
-                self.emit(OP_DEC_FIXED, a=t.size,
-                          col=self.col(path + "#dec", COL_U8, region))
+                i = self.emit(OP_DEC_FIXED, a=t.size,
+                              col=self.col(path + "#dec", COL_U8, region))
+                self.aux[i] = ("decimal", t.precision)
             else:
                 i = self.emit(OP_FIXED, a=t.size,
                               col=self.col(path + "#fix", COL_U8, region))

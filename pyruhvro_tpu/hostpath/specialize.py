@@ -615,6 +615,16 @@ PyObject* py_decode_spec(PyObject*, PyObject* args) {{
       coltypes_obj, list_obj, nthreads);
 }}
 
+PyObject* py_decode_arrow_spec(PyObject*, PyObject* args) {{
+  PyObject *coltypes_obj, *list_obj;
+  int nthreads = 0;
+  if (!PyArg_ParseTuple(args, "OO|i", &coltypes_obj, &list_obj, &nthreads))
+    return nullptr;
+  return decode_arrow_boundary(
+      [](Reader& r, std::vector<Col>& cols) {{ decode_record(r, cols); }},
+      kOps, kAux, coltypes_obj, list_obj, nthreads);
+}}
+
 PyObject* py_encode_spec(PyObject*, PyObject* args) {{
   PyObject *coltypes_obj, *bufs_obj;
   Py_ssize_t n;
@@ -643,11 +653,14 @@ PyObject* py_encode_arrow_spec(PyObject*, PyObject* args) {{
 PyMethodDef methods[] = {{
     {{"decode", py_decode_spec, METH_VARARGS,
      "decode(coltypes, data, nthreads=0) -> (buffers, err_record, err_bits)"}},
+    {{"decode_arrow", py_decode_arrow_spec, METH_VARARGS,
+     "decode_arrow(coltypes, data, nthreads=0) -> "
+     "((tag, payload), err_record, err_bits)"}},
     {{"encode", py_encode_spec, METH_VARARGS,
-     "encode(coltypes, buffers, n, size_hint=0) -> (blob, sizes)"}},
+     "encode(coltypes, buffers, n, size_hint=0) -> (blob, offsets)"}},
     {{"encode_arrow", py_encode_arrow_spec, METH_VARARGS,
      "encode_arrow(coltypes, addr_array, addr_schema, n, checked=0)"
-     " -> (blob, sizes, t_extract_s, t_encode_s) | status int"}},
+     " -> (blob, offsets, t_extract_s, t_encode_s) | status int"}},
     {{nullptr, nullptr, 0, nullptr}},
 }};
 
@@ -679,8 +692,14 @@ def _static_tables(prog: HostProgram) -> str:
             entries.append("    {AUX_NONE, nullptr, nullptr, 0},")
         elif e[0] == "uuid":
             entries.append("    {AUX_UUID, nullptr, nullptr, 0},")
+        elif e[0] == "binary":
+            entries.append("    {AUX_BINARY, nullptr, nullptr, 0},")
         elif e[0] == "duration":
             entries.append("    {AUX_DURATION, nullptr, nullptr, 0},")
+        elif e[0] == "decimal":  # ("decimal", precision)
+            entries.append(
+                f"    {{AUX_DECIMAL, nullptr, nullptr, {int(e[1])}}},"
+            )
         else:  # ("enum", symbol_bytes, ...)
             syms = e[1:]
             for k, s in enumerate(syms):
@@ -704,7 +723,7 @@ def _static_tables(prog: HostProgram) -> str:
 
 
 def generate_source(prog: HostProgram, mod_name: str,
-                    core_include: str = "../extract_core.h") -> str:
+                    core_include: str = "../arrow_decode_core.h") -> str:
     """The C++ translation unit for one schema's decoder + encoder."""
     g = _Gen(prog.ops)
     g.gen(0, True)
@@ -748,7 +767,8 @@ def load_specialized(prog: HostProgram):
     spec_dir = os.path.join(_native_dir(), "_spec")
     try:
         core_text = ""
-        for name in ("host_vm_core.h", "extract_core.h"):
+        for name in ("host_vm_core.h", "extract_core.h",
+                     "arrow_decode_core.h"):
             with open(os.path.join(_native_dir(), name)) as f:
                 core_text += f.read() + "\x00"
         probe = generate_source(prog, "M")  # name-independent content

@@ -35,7 +35,11 @@ from ..schema.model import (
     Union,
 )
 
-__all__ = ["build_record_batch", "compact_union_slices"]
+__all__ = [
+    "build_record_batch",
+    "build_fused_record_batch",
+    "compact_union_slices",
+]
 
 
 def _contains_union(dt: pa.DataType) -> bool:
@@ -622,6 +626,162 @@ class _Assembler:
         )
 
 
+class _FusedNodes:
+    """Positional cursor over the fused decoder's flat node list
+    (``runtime/native/arrow_decode_core.h``) — both sides walk the same
+    schema tree pre-order, so entries carry no keys."""
+
+    __slots__ = ("nodes", "i")
+
+    def __init__(self, nodes):
+        self.nodes = nodes
+        self.i = 0
+
+    def next(self):
+        e = self.nodes[self.i]
+        self.i += 1
+        return e
+
+
+def _fused_build(t: AvroType, dt: pa.DataType, count: int,
+                 it: _FusedNodes) -> pa.Array:
+    """One schema node from its finished native buffers — the fused
+    mirror of ``_Assembler.build``: every buffer arrives in final Arrow
+    layout (validity bitmaps, leading-0 offsets, int8 type ids), so
+    this walk is pure ``pa.Array.from_buffers`` composition; no numpy
+    op exists anywhere on this path."""
+    if isinstance(t, Union) and t.is_nullable_pair:
+        # the native pass folded the wrapper's validity into the child
+        return _fused_build(t.non_null_variant, dt, count, it)
+
+    if isinstance(t, Primitive):
+        name = t.name
+        if name == "null":
+            return pa.nulls(count, pa.null())
+        if name in ("string", "bytes") and t.logical != "uuid" \
+                and t.logical != "decimal":
+            nc, vb, offs, vals = it.next()
+            return pa.Array.from_buffers(
+                dt, count,
+                [None if vb is None else pa.py_buffer(vb),
+                 pa.py_buffer(offs), pa.py_buffer(vals)],
+                null_count=nc,
+            )
+        # uuid / decimal / numeric / boolean: one value buffer
+        nc, vb, data = it.next()
+        return pa.Array.from_buffers(
+            dt, count,
+            [None if vb is None else pa.py_buffer(vb), pa.py_buffer(data)],
+            null_count=nc,
+        )
+    if isinstance(t, (Fixed, Enum)):
+        if isinstance(t, Enum):
+            nc, vb, offs, vals = it.next()
+            return pa.Array.from_buffers(
+                pa.utf8(), count,
+                [None if vb is None else pa.py_buffer(vb),
+                 pa.py_buffer(offs), pa.py_buffer(vals)],
+                null_count=nc,
+            )
+        nc, vb, data = it.next()
+        return pa.Array.from_buffers(
+            dt, count,
+            [None if vb is None else pa.py_buffer(vb), pa.py_buffer(data)],
+            null_count=nc,
+        )
+    if isinstance(t, Record):
+        nc, vb = it.next()
+        children = [
+            _fused_build(f.type, dt.field(i).type, count, it)
+            for i, f in enumerate(t.fields)
+        ]
+        return pa.Array.from_buffers(
+            dt, count,
+            [None if vb is None else pa.py_buffer(vb)],
+            null_count=nc, children=children,
+        )
+    if isinstance(t, Union):
+        (tid8,) = it.next()
+        tid_arr = pa.Array.from_buffers(
+            pa.int8(), count, [None, pa.py_buffer(tid8)]
+        )
+        children = []
+        names = []
+        for k, v in enumerate(t.variants):
+            child_field = dt.field(k)
+            names.append(child_field.name)
+            if v.is_null():
+                children.append(pa.nulls(count, pa.null()))
+            else:
+                children.append(
+                    _fused_build(v, child_field.type, count, it)
+                )
+        return pa.UnionArray.from_sparse(
+            tid_arr, children,
+            field_names=names, type_codes=list(dt.type_codes),
+        )
+    if isinstance(t, (Array, Map)):
+        nc, vb, offs, total = it.next()
+        vbuf = None if vb is None else pa.py_buffer(vb)
+        if isinstance(t, Array):
+            child = _fused_build(t.items, dt.value_field.type, total, it)
+            return pa.Array.from_buffers(
+                dt, count, [vbuf, pa.py_buffer(offs)],
+                null_count=nc, children=[child],
+            )
+        knc, kvb, koffs, kvals = it.next()  # map keys: a string entry
+        keys = pa.Array.from_buffers(
+            pa.utf8(), total,
+            [None if kvb is None else pa.py_buffer(kvb),
+             pa.py_buffer(koffs), pa.py_buffer(kvals)],
+            null_count=knc,
+        )
+        vals = _fused_build(t.values, dt.item_type, total, it)
+        entries = pa.StructArray.from_arrays(
+            [keys, vals], fields=[dt.key_field, dt.item_field]
+        )
+        return pa.Array.from_buffers(
+            dt, count, [vbuf, pa.py_buffer(offs)],
+            null_count=nc, children=[entries],
+        )
+    raise NotImplementedError(repr(t))
+
+
+def _empty_fields_batch(n: int) -> pa.RecordBatch:
+    """An n-row batch for a zero-field schema, built without an n-long
+    Python list (shared by both assembly engines)."""
+    return pa.RecordBatch.from_struct_array(
+        pa.Array.from_buffers(pa.struct([]), n, [None], children=[])
+    )
+
+
+def build_fused_record_batch(
+    ir: Record,
+    arrow_schema: pa.Schema,
+    nodes,
+    n: int,
+) -> pa.RecordBatch:
+    """RecordBatch from the fused native decoder's node list — the
+    zero-copy handoff: every ``pa.py_buffer`` wraps the returned bytes
+    objects in place. Raises if the node list and schema disagree
+    (a contract violation, not a data error)."""
+    it = _FusedNodes(nodes)
+    arrays = [
+        _fused_build(f.type, arrow_schema.field(i).type, n, it)
+        for i, f in enumerate(ir.fields)
+    ]
+    if it.i != len(nodes):
+        # the positional protocol's one failure mode is a silent walk
+        # desync — unconsumed entries must never pass as a valid batch
+        raise ValueError(
+            f"fused decode walk desync: {len(nodes) - it.i} node "
+            f"entr{'y' if len(nodes) - it.i == 1 else 'ies'} unconsumed"
+        )
+    if not arrays:
+        return _empty_fields_batch(n)
+    return pa.RecordBatch.from_arrays(arrays, schema=arrow_schema)
+
+
 def build_record_batch(
     ir: Record,
     arrow_schema: pa.Schema,
@@ -635,7 +795,5 @@ def build_record_batch(
         for i, f in enumerate(ir.fields)
     ]
     if not arrays:
-        return pa.RecordBatch.from_struct_array(
-            pa.array([{}] * n, pa.struct([]))
-        )
+        return _empty_fields_batch(n)
     return pa.RecordBatch.from_arrays(arrays, schema=arrow_schema)

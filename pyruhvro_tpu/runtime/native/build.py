@@ -61,7 +61,8 @@ def _cpu_tag() -> str:
 def _needs_build(so: str, src: str) -> bool:
     src_mtime = os.path.getmtime(src)
     # editing a shared core header must rebuild its includers too
-    for name in ("host_vm_core.h", "extract_core.h"):
+    for name in ("host_vm_core.h", "extract_core.h",
+                 "arrow_decode_core.h"):
         hdr = os.path.join(_HERE, name)
         if os.path.exists(hdr):
             src_mtime = max(src_mtime, os.path.getmtime(hdr))

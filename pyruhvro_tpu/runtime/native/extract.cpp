@@ -7,7 +7,7 @@
 //
 // Entry points (hostpath/codec.py glue):
 //   encode(ops, coltypes, aux, addr_array, addr_schema, n, checked)
-//     -> (blob, sizes, t_extract_s, t_encode_s) | int status
+//     -> (blob, offsets[n+1], t_extract_s, t_encode_s) | int status
 //   The fused fast path: walk the RecordBatch's validity/offset/data
 //   buffers via the Arrow C data interface (GIL released), then run the
 //   generic encode VM over the in-memory plan columns — no Python/numpy
@@ -25,59 +25,8 @@ namespace {
 
 using namespace pyr;
 
-// Parsed aux tables; symbol bytes are BORROWED from the aux tuple,
-// which the caller keeps alive for the duration of the call.
-struct AuxTables {
-  std::vector<OpAux> aux;
-  std::vector<std::vector<const char*>> syms;
-  std::vector<std::vector<int32_t>> symlens;
-
-  bool parse(PyObject* aux_obj, size_t nops) {
-    aux.resize(nops);
-    syms.resize(nops);
-    symlens.resize(nops);
-    if (aux_obj == Py_None) return true;
-    if (!PyTuple_Check(aux_obj) || (size_t)PyTuple_GET_SIZE(aux_obj) != nops) {
-      PyErr_SetString(PyExc_ValueError, "aux must be a tuple of len(ops)");
-      return false;
-    }
-    for (size_t i = 0; i < nops; i++) {
-      PyObject* e = PyTuple_GET_ITEM(aux_obj, i);
-      if (e == Py_None) continue;
-      if (!PyTuple_Check(e) || PyTuple_GET_SIZE(e) < 1) {
-        PyErr_SetString(PyExc_ValueError, "bad aux entry");
-        return false;
-      }
-      PyObject* tag = PyTuple_GET_ITEM(e, 0);
-      const char* t = PyUnicode_AsUTF8(tag);
-      if (t == nullptr) return false;
-      if (std::strcmp(t, "uuid") == 0) {
-        aux[i].lane = AUX_UUID;
-      } else if (std::strcmp(t, "duration") == 0) {
-        aux[i].lane = AUX_DURATION;
-      } else if (std::strcmp(t, "enum") == 0) {
-        aux[i].lane = AUX_ENUM;
-        Py_ssize_t ns = PyTuple_GET_SIZE(e) - 1;
-        for (Py_ssize_t k = 0; k < ns; k++) {
-          PyObject* sb = PyTuple_GET_ITEM(e, (Py_ssize_t)(k + 1));
-          if (!PyBytes_Check(sb)) {
-            PyErr_SetString(PyExc_ValueError, "enum symbols must be bytes");
-            return false;
-          }
-          syms[i].push_back(PyBytes_AS_STRING(sb));
-          symlens[i].push_back((int32_t)PyBytes_GET_SIZE(sb));
-        }
-        aux[i].syms = syms[i].data();
-        aux[i].symlens = symlens[i].data();
-        aux[i].nsyms = (int32_t)syms[i].size();
-      } else {
-        PyErr_Format(PyExc_ValueError, "unknown aux tag %s", t);
-        return false;
-      }
-    }
-    return true;
-  }
-};
+// AuxTables (the parsed ``op_aux`` tuple) now lives in extract_core.h,
+// shared with the generic fused-decode entry in host_codec.cpp.
 
 bool parse_ops(PyObject* ops_obj, BufferGuard* guard, const Op** ops,
                size_t* nops) {
@@ -143,7 +92,7 @@ PyMethodDef methods[] = {
 #endif
     {"encode", py_encode_arrow, METH_VARARGS,
      "encode(ops, coltypes, aux, addr_array, addr_schema, n, checked=0)"
-     " -> (blob, sizes, t_extract_s, t_encode_s) | status int"},
+     " -> (blob, offsets[n+1], t_extract_s, t_encode_s) | status int"},
     {"extract", py_extract_arrow, METH_VARARGS,
      "extract(ops, coltypes, aux, addr_array, addr_schema, n)"
      " -> (buffers, bound) | status int"},

@@ -87,13 +87,85 @@ enum AuxLane : int8_t {
   AUX_UUID = 1,      // OP_STRING with uuid logical (Arrow w:16 → text)
   AUX_DURATION = 2,  // OP_FIXED duration (Arrow tDm → 12B wire triple)
   AUX_ENUM = 3,      // OP_ENUM: symbol table for utf8 → index matching
+  AUX_BINARY = 4,    // OP_STRING that is Avro bytes (no UTF-8 contract)
+  AUX_DECIMAL = 5,   // OP_DEC_*: declared precision (in ``nsyms``)
 };
 
 struct OpAux {
   int8_t lane = AUX_NONE;
   const char* const* syms = nullptr;  // AUX_ENUM: utf8 symbol bytes
   const int32_t* symlens = nullptr;
-  int32_t nsyms = 0;
+  int32_t nsyms = 0;                  // AUX_ENUM: count; AUX_DECIMAL: precision
+};
+
+// Parsed aux tables (the Python ``op_aux`` tuple — one entry per op:
+// None, ("uuid",), ("binary",), ("duration",), ("decimal", precision)
+// or ("enum", symbol_bytes...)). Symbol bytes are BORROWED from the aux
+// tuple, which the caller keeps alive for the duration of the call.
+// Shared by the generic extractor module (extract.cpp) and the generic
+// fused-decode entry (host_codec.cpp); specialized modules embed their
+// tables as static data instead.
+struct AuxTables {
+  std::vector<OpAux> aux;
+  std::vector<std::vector<const char*>> syms;
+  std::vector<std::vector<int32_t>> symlens;
+
+  bool parse(PyObject* aux_obj, size_t nops) {
+    aux.resize(nops);
+    syms.resize(nops);
+    symlens.resize(nops);
+    if (aux_obj == Py_None) return true;
+    if (!PyTuple_Check(aux_obj) || (size_t)PyTuple_GET_SIZE(aux_obj) != nops) {
+      PyErr_SetString(PyExc_ValueError, "aux must be a tuple of len(ops)");
+      return false;
+    }
+    for (size_t i = 0; i < nops; i++) {
+      PyObject* e = PyTuple_GET_ITEM(aux_obj, i);
+      if (e == Py_None) continue;
+      if (!PyTuple_Check(e) || PyTuple_GET_SIZE(e) < 1) {
+        PyErr_SetString(PyExc_ValueError, "bad aux entry");
+        return false;
+      }
+      PyObject* tag = PyTuple_GET_ITEM(e, 0);
+      const char* t = PyUnicode_AsUTF8(tag);
+      if (t == nullptr) return false;
+      if (std::strcmp(t, "uuid") == 0) {
+        aux[i].lane = AUX_UUID;
+      } else if (std::strcmp(t, "binary") == 0) {
+        aux[i].lane = AUX_BINARY;
+      } else if (std::strcmp(t, "duration") == 0) {
+        aux[i].lane = AUX_DURATION;
+      } else if (std::strcmp(t, "decimal") == 0) {
+        aux[i].lane = AUX_DECIMAL;
+        if (PyTuple_GET_SIZE(e) < 2) {
+          PyErr_SetString(PyExc_ValueError, "decimal aux needs precision");
+          return false;
+        }
+        long prec = PyLong_AsLong(PyTuple_GET_ITEM(e, 1));
+        if (PyErr_Occurred()) return false;
+        aux[i].nsyms = (int32_t)prec;
+      } else if (std::strcmp(t, "enum") == 0) {
+        aux[i].lane = AUX_ENUM;
+        Py_ssize_t ns = PyTuple_GET_SIZE(e) - 1;
+        for (Py_ssize_t k = 0; k < ns; k++) {
+          PyObject* sb = PyTuple_GET_ITEM(e, (Py_ssize_t)(k + 1));
+          if (!PyBytes_Check(sb)) {
+            PyErr_SetString(PyExc_ValueError, "enum symbols must be bytes");
+            return false;
+          }
+          syms[i].push_back(PyBytes_AS_STRING(sb));
+          symlens[i].push_back((int32_t)PyBytes_GET_SIZE(sb));
+        }
+        aux[i].syms = syms[i].data();
+        aux[i].symlens = symlens[i].data();
+        aux[i].nsyms = (int32_t)syms[i].size();
+      } else {
+        PyErr_Format(PyExc_ValueError, "unknown aux tag %s", t);
+        return false;
+      }
+    }
+    return true;
+  }
 };
 
 // ---- extraction output -----------------------------------------------
@@ -670,7 +742,7 @@ inline void fill_incols(const std::vector<OutBuf>& outs,
 
 // ---- fused boundary: extract + encode in one GIL-released call -------
 //
-// encode_arrow(…) -> (blob, sizes, t_extract_s, t_encode_s)
+// encode_arrow(…) -> (blob, offsets[n+1], t_extract_s, t_encode_s)
 //                  | int status (EXTRACT_FALLBACK / EXTRACT_DATA_ERROR)
 // The caller (hostpath/codec.py) maps an int result back onto the
 // Python extractor path; timings feed the host.extract_native_s /
@@ -711,7 +783,7 @@ inline PyObject* encode_arrow_boundary(Rec rec, const Op* ops,
   std::vector<int32_t> sizes;
   try {
     fill_incols(ex.outs, coltypes, ncols, cols);
-    sizes.resize((size_t)n);
+    sizes.resize((size_t)n + 1);  // Arrow offsets: n+1 slots, leading 0
   } catch (const std::bad_alloc&) {
     PyErr_NoMemory();
     return nullptr;

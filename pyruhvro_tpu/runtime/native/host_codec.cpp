@@ -29,8 +29,10 @@
 // boundary live in host_vm_core.h, SHARED with the schema-specialized
 // decoder modules that hostpath/specialize.py generates — this file
 // adds the generic bytecode interpreter (any schema, no compile step)
-// and the encode engine.
-#include "host_vm_core.h"
+// and the encode engine. arrow_decode_core.h (which pulls in the other
+// shared cores) adds the fused wire→Arrow-buffer finalize behind the
+// ``decode_arrow`` entry.
+#include "arrow_decode_core.h"
 
 namespace {
 
@@ -302,8 +304,43 @@ PyObject* py_decode(PyObject*, PyObject* args) {
   return decode_boundary(rec, coltypes_obj, list_obj, nthreads);
 }
 
+// decode_arrow(ops, coltypes, aux, data, nthreads)
+//   -> (("arrow", nodes) | ("plan", buffers), err_record, err_bits)
+// The fused wire→Arrow-buffer entry: same VM pass as ``decode``, but
+// the merge stage emits finished Arrow-layout buffers (validity
+// bitmaps, leading-0 offsets, int8 union type ids, converted
+// enum/uuid/duration columns) instead of plan buffers — falling back
+// to the plan shape when the finalize declines. ``data`` additionally
+// accepts the zero-copy ("arrowbuf", offsets, values, start, n, width)
+// ingestion descriptor. Schema-specialized modules provide the same
+// ``decode_arrow`` without the ops/aux arguments (embedded tables).
+PyObject* py_decode_arrow(PyObject*, PyObject* args) {
+  PyObject *ops_obj, *coltypes_obj, *aux_obj, *data_obj;
+  int nthreads = 0;
+  if (!PyArg_ParseTuple(args, "OOOO|i", &ops_obj, &coltypes_obj, &aux_obj,
+                        &data_obj, &nthreads))
+    return nullptr;
+
+  BufferGuard ops_b;
+  if (!ops_b.acquire(ops_obj, "ops")) return nullptr;
+  if (ops_b.view.len % sizeof(Op) != 0) {
+    PyErr_SetString(PyExc_ValueError, "ops buffer size not a multiple of op size");
+    return nullptr;
+  }
+  const Op* ops = static_cast<const Op*>(ops_b.view.buf);
+  size_t nops = (size_t)(ops_b.view.len / sizeof(Op));
+  AuxTables at;
+  if (!at.parse(aux_obj, nops)) return nullptr;
+  auto rec = [ops](Reader& r, std::vector<Col>& cols) {
+    Vm vm(ops, &cols);
+    vm.exec(0, r, true);
+  };
+  return decode_arrow_boundary(rec, ops, at.aux.data(), coltypes_obj,
+                               data_obj, nthreads);
+}
+
 // encode(ops, coltypes, buffers: list, n, size_hint=0)
-//   -> (blob: bytes, sizes: bytes)
+//   -> (blob: bytes, offsets: bytes of n+1 int32, leading 0)
 // The generic-interpreter entry: parses the opcode program and runs it
 // through the shared boundary (host_vm_core.h) with a VM-backed
 // per-record encoder. Schema-specialized modules provide the same
@@ -529,13 +566,16 @@ PyMethodDef methods[] = {
     {"decode", py_decode, METH_VARARGS,
      "decode(ops, coltypes, flat, offsets, n, nthreads=0) -> "
      "(buffers | None, err_record, err_bits)"},
+    {"decode_arrow", py_decode_arrow, METH_VARARGS,
+     "decode_arrow(ops, coltypes, aux, data, nthreads=0) -> "
+     "((tag, payload) | None, err_record, err_bits)"},
 #ifdef PYRUHVRO_NATIVE_PROF
     {"prof_drain", py_prof_drain, METH_NOARGS,
      "prof_drain() -> {telemetry_key: (hits, ns)} (clears the counters)"},
 #endif
     {"encode", py_encode, METH_VARARGS,
      "encode(ops, coltypes, buffers, n, size_hint=0) -> "
-     "(blob, sizes_int32)"},
+     "(blob, offsets_int32[n+1])"},
     {"cumsum0", py_cumsum0, METH_VARARGS,
      "cumsum0(lens_int32) -> int32 offsets bytes (leading 0)"},
     {"uuid16", py_uuid16, METH_VARARGS,

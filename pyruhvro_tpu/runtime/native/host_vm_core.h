@@ -506,6 +506,105 @@ inline void release_spans(std::vector<Py_buffer>& views,
   for (auto* p : pins) Py_DECREF(p);
 }
 
+// ---- zero-copy Arrow-buffer ingestion lane ---------------------------
+//
+// The Python side may hand the datum batch as the tuple
+//   ("arrowbuf", offsets_bufferlike, values_bufferlike, start, n, width)
+// (hostpath/codec.py builds it from a pyarrow Binary/LargeBinaryArray's
+// own buffers) instead of a list of bytes objects: spans then point
+// STRAIGHT into the Arrow values buffer — no per-datum Python object is
+// created or touched anywhere on the ingest boundary. ``width`` is the
+// offset element width (4 = BinaryArray int32, 8 = LargeBinaryArray
+// int64); ``start`` is the array's logical offset into the offsets
+// buffer (a sliced array ships the same buffers with a shifted start).
+inline bool is_arrowbuf_tuple(PyObject* obj) {
+  if (!PyTuple_Check(obj) || PyTuple_GET_SIZE(obj) != 6) return false;
+  PyObject* tag = PyTuple_GET_ITEM(obj, 0);
+  if (!PyUnicode_Check(tag)) return false;
+  const char* t = PyUnicode_AsUTF8(tag);
+  if (t == nullptr) {
+    PyErr_Clear();
+    return false;
+  }
+  return std::strcmp(t, "arrowbuf") == 0;
+}
+
+inline bool collect_spans_arrowbuf(PyObject* tup, std::vector<Span>& spans,
+                                   std::vector<Py_buffer>& views,
+                                   Py_ssize_t* n_out) {
+  PyObject* offs_obj = PyTuple_GET_ITEM(tup, 1);
+  PyObject* vals_obj = PyTuple_GET_ITEM(tup, 2);
+  Py_ssize_t start = PyLong_AsSsize_t(PyTuple_GET_ITEM(tup, 3));
+  Py_ssize_t n = PyLong_AsSsize_t(PyTuple_GET_ITEM(tup, 4));
+  long width = PyLong_AsLong(PyTuple_GET_ITEM(tup, 5));
+  if (PyErr_Occurred()) return false;
+  if (n < 0 || start < 0 || (width != 4 && width != 8)) {
+    PyErr_SetString(PyExc_ValueError, "bad arrowbuf descriptor");
+    return false;
+  }
+  Py_buffer ob, vb;
+  if (PyObject_GetBuffer(offs_obj, &ob, PyBUF_SIMPLE) != 0) return false;
+  views.push_back(ob);
+  if (PyObject_GetBuffer(vals_obj, &vb, PyBUF_SIMPLE) != 0) return false;
+  views.push_back(vb);
+  if ((Py_ssize_t)((start + n + 1) * width) > ob.len) {
+    PyErr_SetString(PyExc_ValueError, "arrowbuf offsets buffer too short");
+    return false;
+  }
+  const uint8_t* base = static_cast<const uint8_t*>(vb.buf);
+  const int64_t vlen = (int64_t)vb.len;
+  spans.reserve((size_t)n);
+  if (width == 4) {
+    const int32_t* off = static_cast<const int32_t*>(ob.buf) + start;
+    for (Py_ssize_t i = 0; i < n; i++) {
+      int64_t a = off[i], b = off[i + 1];
+      if (a < 0 || b < a || b > vlen) {
+        PyErr_Format(PyExc_ValueError,
+                     "arrowbuf offsets corrupt at record %zd", i);
+        return false;
+      }
+      spans.push_back({base + a, (Py_ssize_t)(b - a)});
+    }
+  } else {
+    const int64_t* off = static_cast<const int64_t*>(ob.buf) + start;
+    for (Py_ssize_t i = 0; i < n; i++) {
+      int64_t a = off[i], b = off[i + 1];
+      if (a < 0 || b < a || b > vlen) {
+        PyErr_Format(PyExc_ValueError,
+                     "arrowbuf offsets corrupt at record %zd", i);
+        return false;
+      }
+      spans.push_back({base + a, (Py_ssize_t)(b - a)});
+    }
+  }
+  *n_out = n;
+  return true;
+}
+
+// Owns one decode call's input spans whichever lane produced them
+// (list[bytes] pins + buffer views, or the two arrowbuf views).
+struct SpanCollection {
+  std::vector<Span> spans;
+  std::vector<Py_buffer> views;
+  std::vector<PyObject*> pins;
+  PyObject* seq = nullptr;
+  Py_ssize_t n = 0;
+  ~SpanCollection() {
+    release_spans(views, pins);
+    Py_XDECREF(seq);
+  }
+};
+
+inline bool collect_input(PyObject* data_obj, SpanCollection& sc) {
+  if (is_arrowbuf_tuple(data_obj)) {
+    return collect_spans_arrowbuf(data_obj, sc.spans, sc.views, &sc.n);
+  }
+  sc.seq = PySequence_Fast(data_obj, "data must be a sequence");
+  if (!sc.seq) return false;
+  sc.n = PySequence_Fast_GET_SIZE(sc.seq);
+  return collect_spans(sc.seq, sc.spans, sc.views, sc.pins);
+}
+
 struct ShardResult {
   std::vector<Col> cols;
   int64_t err_record = -1;
@@ -707,37 +806,15 @@ inline void run_shard_t(RecFn rec, const int32_t* coltypes, size_t ncols,
   PYR_PROF_FLUSH();
 }
 
-// decode boundary: (coltypes, data_list, nthreads) with the decoder
-// supplied by the caller -> (buffers: list[bytes], err_record, err_bits)
-// ``data_list`` is the caller's list[bytes] — records decode straight
-// from the original Python buffers (span collection under the GIL, like
-// the packer shim), so no host-side concatenation pass or flat copy
-// exists at all. Buffer order: for each column in order — COL_STR
-// contributes two entries (value bytes uint8, len int32); others one.
-// COL_OFFS buffers carry running totals only; Python prepends the 0.
+// Run the whole decode over collected spans: sharding, the sampled-
+// reserve prepass and the worker threads (GIL released inside). Shared
+// by the plan-buffer boundary below and the fused Arrow boundary
+// (arrow_decode_core.h).
 template <class RecFn>
-inline PyObject* decode_boundary(RecFn rec, PyObject* coltypes_obj,
-                                 PyObject* list_obj, int nthreads) {
-  BufferGuard ct_b;
-  if (!ct_b.acquire(coltypes_obj, "coltypes")) return nullptr;
-  const int32_t* coltypes = static_cast<const int32_t*>(ct_b.view.buf);
-  size_t ncols = (size_t)(ct_b.view.len / sizeof(int32_t));
-
-  PyObject* seq = PySequence_Fast(list_obj, "data must be a sequence");
-  if (!seq) return nullptr;
-  Py_ssize_t n = PySequence_Fast_GET_SIZE(seq);
-  std::vector<Span> spans;
-  std::vector<Py_buffer> views;
-  std::vector<PyObject*> pins;
-  PYR_PROF_OP(pyr::prof::DOM_VM, pyr::prof::P_COLLECT);
-  bool spans_ok = collect_spans(seq, spans, views, pins);
-  PYR_PROF_STOP();
-  if (!spans_ok) {
-    release_spans(views, pins);
-    Py_DECREF(seq);
-    return nullptr;
-  }
-
+inline void run_all_shards(RecFn rec, const int32_t* coltypes, size_t ncols,
+                           const SpanCollection& sc, int nthreads,
+                           std::vector<ShardResult>& shards) {
+  Py_ssize_t n = sc.n;
   int nt = pick_threads(n, nthreads);
   // NOTE (measured twice, r05): neither sub-sharding the serial path
   // (~4k-row shards, all live) NOR an incremental merge-and-free
@@ -746,7 +823,8 @@ inline PyObject* decode_boundary(RecFn rec, PyObject* coltypes_obj,
   // growing accumulators pay realloc/page-fault churn that cancels the
   // builder-locality win. One shard per thread stays; revisit only
   // with a two-pass exact-size merge if this cell matters again.
-  std::vector<ShardResult> shards((size_t)nt);
+  shards.resize((size_t)nt);
+  const std::vector<Span>& spans = sc.spans;
 
   Py_BEGIN_ALLOW_THREADS;
   // large batches: decode a small evenly-strided sample first and
@@ -788,18 +866,21 @@ inline PyObject* decode_boundary(RecFn rec, PyObject* coltypes_obj,
       int64_t a = per * t;
       int64_t b = (t == nt - 1) ? n : per * (t + 1);
       ShardResult* sr = &shards[(size_t)t];
-      double sc = total_scale * ((double)(b - a) / (double)n);
+      double sc2 = total_scale * ((double)(b - a) / (double)n);
       threads.emplace_back([rec, coltypes, ncols, &spans, a, b, sr, pp,
-                            sc]() {
-        run_shard_t(rec, coltypes, ncols, spans.data(), a, b, sr, pp, sc);
+                            sc2]() {
+        run_shard_t(rec, coltypes, ncols, spans.data(), a, b, sr, pp, sc2);
       });
     }
     for (auto& th : threads) th.join();
   }
   Py_END_ALLOW_THREADS;
-  release_spans(views, pins);
-  Py_DECREF(seq);
+}
 
+// Scan shard results for errors; returns nullptr when decoding may
+// proceed, else the (None, err_record, err_bits) result (or sets a
+// Python error for OOM shards).
+inline PyObject* shard_error_result(const std::vector<ShardResult>& shards) {
   for (auto& s : shards) {
     if (s.err_record == -2) {
       PyErr_NoMemory();
@@ -809,11 +890,15 @@ inline PyObject* decode_boundary(RecFn rec, PyObject* coltypes_obj,
       return Py_BuildValue("(OLi)", Py_None, (long long)s.err_record,
                            (int)s.err_bits);
   }
+  return nullptr;
+}
 
-  // one output buffer per column (two for COL_STR), allocated at the
-  // summed size and filled per shard by build_col_buffer — COL_OFFS
-  // rebases during the copy, every other type is a straight memcpy
-  PYR_PROF_OP(pyr::prof::DOM_VM, pyr::prof::P_MERGE);
+// The legacy plan-buffer list: one output buffer per column (two for
+// COL_STR), allocated at the summed size and filled per shard by
+// build_col_buffer — COL_OFFS rebases during the copy, every other type
+// is a straight memcpy.
+inline PyObject* build_plan_buffers(const std::vector<ShardResult>& shards,
+                                    const int32_t* coltypes, size_t ncols) {
   PyObject* bufs = PyList_New(0);
   if (!bufs) return nullptr;
   for (size_t c = 0; c < ncols; c++) {
@@ -834,6 +919,41 @@ inline PyObject* decode_boundary(RecFn rec, PyObject* coltypes_obj,
       Py_DECREF(b);
     }
   }
+  return bufs;
+}
+
+// decode boundary: (coltypes, data, nthreads) with the decoder
+// supplied by the caller -> (buffers: list[bytes], err_record, err_bits)
+// ``data`` is the caller's list[bytes] (records decode straight from
+// the original Python buffers — span collection under the GIL, like
+// the packer shim, so no host-side concatenation pass or flat copy
+// exists at all) or the zero-copy ``("arrowbuf", ...)`` descriptor of a
+// pyarrow Binary/LargeBinaryArray's own buffers. Buffer order: for each
+// column in order — COL_STR contributes two entries (value bytes uint8,
+// len int32); others one. COL_OFFS buffers carry running totals only;
+// Python prepends the 0.
+template <class RecFn>
+inline PyObject* decode_boundary(RecFn rec, PyObject* coltypes_obj,
+                                 PyObject* list_obj, int nthreads) {
+  BufferGuard ct_b;
+  if (!ct_b.acquire(coltypes_obj, "coltypes")) return nullptr;
+  const int32_t* coltypes = static_cast<const int32_t*>(ct_b.view.buf);
+  size_t ncols = (size_t)(ct_b.view.len / sizeof(int32_t));
+
+  SpanCollection sc;
+  PYR_PROF_OP(pyr::prof::DOM_VM, pyr::prof::P_COLLECT);
+  bool spans_ok = collect_input(list_obj, sc);
+  PYR_PROF_STOP();
+  if (!spans_ok) return nullptr;
+
+  std::vector<ShardResult> shards;
+  run_all_shards(rec, coltypes, ncols, sc, nthreads, shards);
+  PyObject* err = shard_error_result(shards);
+  if (err != nullptr || PyErr_Occurred()) return err;
+
+  PYR_PROF_OP(pyr::prof::DOM_VM, pyr::prof::P_MERGE);
+  PyObject* bufs = build_plan_buffers(shards, coltypes, ncols);
+  if (!bufs) return nullptr;
   PyObject* out = Py_BuildValue("(OLi)", bufs, (long long)-1, 0);
   Py_DECREF(bufs);
   PYR_PROF_FLUSH();
@@ -1117,11 +1237,14 @@ struct VmEncRec {
 // the per-record encoder. ``Rec`` is a functor with
 // ``template<class W> bool operator()(W&, std::vector<InCol>&)`` that
 // encodes ONE record and returns false on a decimal range error.
+// ``offs`` has n+1 slots and receives the ARROW OFFSETS layout directly
+// (leading 0, then the running wire position after each record) — the
+// caller wraps it in a BinaryArray with no Python-side prefix-sum pass.
 template <class Rec, class W>
 inline void run_encode_t(Rec rec, std::vector<InCol>& cols, W& w,
-                         Py_ssize_t n, int32_t* sizes, bool* overflow,
+                         Py_ssize_t n, int32_t* offs, bool* overflow,
                          bool* vm_err) {
-  size_t prev = 0;
+  offs[0] = 0;
   for (Py_ssize_t i = 0; i < n; i++) {
     if (!rec(w, cols)) {
       *vm_err = true;
@@ -1134,14 +1257,15 @@ inline void run_encode_t(Rec rec, std::vector<InCol>& cols, W& w,
       PYR_PROF_FLUSH();
       return;
     }
-    sizes[i] = (int32_t)(pos - prev);
-    prev = pos;
+    offs[i + 1] = (int32_t)pos;
   }
   PYR_PROF_FLUSH();
 }
 
 // encode boundary: (coltypes, buffers, n, size_hint) with the encoder
-// supplied by the caller -> (blob: bytes, sizes: bytes). ``buffers``
+// supplied by the caller -> (blob: bytes, offsets: bytes of n+1 int32,
+// leading 0 — the Arrow Binary offsets layout, ready for
+// ``pa.Array.from_buffers`` with no Python-side prefix sum). ``buffers``
 // follows the decode buffer order (COL_STR: bytes then lens);
 // ``size_hint`` (the extractor's byte bound) pre-sizes the output so
 // the hot loop never reallocates. Raises OverflowError when the wire
@@ -1216,7 +1340,7 @@ inline PyObject* encode_boundary(Rec rec, PyObject* coltypes_obj,
 
   std::vector<int32_t> sizes;
   try {
-    sizes.resize((size_t)n);
+    sizes.resize((size_t)n + 1);  // Arrow offsets: n+1 slots, leading 0
   } catch (const std::bad_alloc&) {
     Py_DECREF(seq);
     PyErr_NoMemory();

@@ -98,6 +98,11 @@ def single10m(rows: int) -> None:
     dt_en = time.perf_counter() - t0
     assert sum(len(a) for a in arrays) == rows
     _log(f"[north-star] encode: {dt_en:.2f}s = {rows/dt_en:,.0f} rec/s")
+    from pyruhvro_tpu.runtime import metrics as _metrics
+
+    snap = _metrics.snapshot()
+    f_hit = int(snap.get("decode.fused", 0))
+    f_fb = int(snap.get("decode.fused_fallback", 0))
     _record({
         "mode": "single10m", "rows": rows,
         "decode_s": round(dt_de, 3),
@@ -106,6 +111,14 @@ def single10m(rows: int) -> None:
         "encode_s": round(dt_en, 3),
         "encode_rec_s": round(rows / dt_en, 1),
         "encode_vs_baseline": round(rows / dt_en / BASELINE_ENCODE, 4),
+        # absolute rec/s only compares within one machine class: carry
+        # the recording box's shape + the fused-decode coverage so a
+        # slower box's honest reseed never reads as a codec regression
+        "machine": {"cpus": os.cpu_count()},
+        **({"fused_decode": {
+            "fused": f_hit, "fallback": f_fb,
+            "hit_rate": round(f_hit / (f_hit + f_fb), 4),
+        }} if (f_hit or f_fb) else {}),
     })
 
 

@@ -99,13 +99,25 @@ def measure_cases(rows: int, chunks: int, reps: int) -> Dict[str, dict]:
     )
     from pyruhvro_tpu.utils.datagen import KAFKA_SCHEMA_JSON as K
 
+    from pyruhvro_tpu.runtime import metrics as _metrics
+
     datums = _gen_kafka(rows)
     out: Dict[str, dict] = {}
 
+    _metrics.reset()
     times = _time_reps(
         lambda: deserialize_array_threaded(datums, K, chunks,
                                            backend="host"), reps)
-    out[case_key("kafka", "deserialize", "host", rows, chunks)] = _band(times)
+    band = _band(times)
+    # fused wire→Arrow coverage on the headline case (ISSUE 9): the
+    # fallback counter is a budget, not an FYI — compare() ignores the
+    # extra key, main() asserts on it, and the baseline records it
+    snap = _metrics.snapshot()
+    f_hit = int(snap.get("decode.fused", 0))
+    f_fb = int(snap.get("decode.fused_fallback", 0))
+    if f_hit or f_fb:
+        band["fused_coverage"] = round(f_hit / (f_hit + f_fb), 4)
+    out[case_key("kafka", "deserialize", "host", rows, chunks)] = band
 
     # the policy layer must be FREE when unused: the explicit
     # on_error="raise" spelling is measured as its own case and held to
@@ -597,6 +609,18 @@ def main(argv: Optional[list] = None) -> int:
              "and the baseline")
         return 2
     failed = False
+    # fused-decode coverage budget (ISSUE 9): when the native tier
+    # served the kafka case, at least 95% of its decode calls must have
+    # gone through the fused wire→Arrow pass — a creeping fallback rate
+    # is a perf regression even when the medians still squeak by
+    for key, band in fresh.items():
+        cov = band.get("fused_coverage") if isinstance(band, dict) else None
+        if cov is None:
+            continue
+        ok = cov >= 0.95
+        _log(f"[perf-gate] {key}: fused decode coverage "
+             f"{cov * 100:.1f}% -> {'ok' if ok else 'FAILED (<95%)'}")
+        failed = failed or not ok
     for key, med, allowed, regressed in rows:
         verdict = "REGRESSED" if regressed else "ok"
         _log(f"[perf-gate] {key}: {med * 1e3:.3f} ms vs allowed "

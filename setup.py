@@ -10,6 +10,15 @@ packer.
 
 from setuptools import Extension, setup
 
+# shared header-only cores: editing any must rebuild the includers
+# (host_codec.cpp additionally pulls in the fused wire→Arrow finalize,
+# arrow_decode_core.h, behind its decode_arrow entry)
+_CORES = [
+    "pyruhvro_tpu/runtime/native/host_vm_core.h",
+    "pyruhvro_tpu/runtime/native/extract_core.h",
+    "pyruhvro_tpu/runtime/native/arrow_decode_core.h",
+]
+
 setup(
     ext_modules=[
         Extension(
@@ -22,6 +31,7 @@ setup(
         Extension(
             "pyruhvro_tpu.runtime.native._pyruhvro_hostcodec",
             sources=["pyruhvro_tpu/runtime/native/host_codec.cpp"],
+            depends=_CORES,
             language="c++",
             extra_compile_args=["-O3", "-std=c++17", "-pthread"],
             optional=True,
@@ -29,6 +39,7 @@ setup(
         Extension(
             "pyruhvro_tpu.runtime.native._pyruhvro_extract",
             sources=["pyruhvro_tpu/runtime/native/extract.cpp"],
+            depends=_CORES,
             language="c++",
             extra_compile_args=["-O3", "-std=c++17", "-pthread"],
             optional=True,
