@@ -253,6 +253,22 @@ def _run_case(op, schema, datums, backend, chunks, reps, details,
             "warmup_excludes_compile": (
                 snap.get("device.jit_cache.hits", 0) > 0
             ),
+            # h2d/compute overlap + persistent-arena reuse (ISSUE 10):
+            # overlap_frac > 0 = pack/h2d of one chunk ran while
+            # another chunk's launch was in flight
+            "overlap_s": round(snap.get("device.overlap_s", 0.0), 6),
+            "overlap_frac": round(
+                snap.get("device.overlap_s", 0.0)
+                / snap["device.pipeline_s"], 4)
+            if snap.get("device.pipeline_s") else 0.0,
+            "arena": {
+                "hits": int(snap.get("device.arena.hits", 0)),
+                "misses": int(snap.get("device.arena.misses", 0)),
+            },
+            "capacity_plan": {
+                "hits": int(snap.get("device.capacity.plan_hits", 0)),
+                "misses": int(snap.get("device.capacity.plan_misses", 0)),
+            },
         }
         _log(f"[bench] {label or ''}{op}[{backend}] device split: "
              f"compile {device['compile_s'] * 1e3:.1f} ms "
@@ -548,6 +564,9 @@ def main() -> None:
     ap.add_argument("--probe-timeout", type=float,
                     default=float(os.environ.get(
                         "PYRUHVRO_TPU_PROBE_TIMEOUT", 300)))
+    ap.add_argument("--mesh-rows", type=int,
+                    default=int(os.environ.get("BENCH_MESH_ROWS", 20_000)),
+                    help="spoofed-8-device mesh leg row count (0 = skip)")
     ap.add_argument("--matrix", action="store_true", default=True)
     ap.add_argument("--no-matrix", dest="matrix", action="store_false",
                     help="skip the criterion shape matrix + chunk sweep")
@@ -774,6 +793,12 @@ def main() -> None:
                           max(2, args.reps - 2), details, label="sweep/")
         save_details()
 
+    # mesh leg (ISSUE 10): the spoofed 8-device shard_map decomposition
+    # — subprocess-isolated, so a wedged real backend cannot block it
+    if args.mesh_rows:
+        _bench_mesh(args.mesh_rows, details)
+        save_details()
+
     # optional fastavro comparison (≙ scripts/benchmark_sweep.py)
     try:
         import fastavro  # noqa: F401
@@ -790,6 +815,50 @@ def main() -> None:
     # ... and the driver reads the LAST stdout line: print it (again)
     # as the final act (VERDICT r03: BENCH_r03.json parsed=null)
     print(_headline_line(), flush=True)
+
+
+def _bench_mesh(rows, details):
+    """The shard_map mesh leg (ISSUE 10) on a spoofed 8-device CPU mesh,
+    in a subprocess — device-count spoofing must precede the first jax
+    import, and this process initialized its real backend long ago. The
+    NORTH_STAR-shaped entry (cold-vs-warm split, per-phase
+    pack/h2d/launch/d2h decomposition, overlap fraction, warm retry
+    count) lands in BENCH_DETAILS.json as the ``mesh`` section."""
+    import subprocess
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    env = dict(
+        os.environ,
+        JAX_PLATFORMS="cpu",
+        XLA_FLAGS=(os.environ.get("XLA_FLAGS", "")
+                   + " --xla_force_host_platform_device_count=8").strip(),
+        PYRUHVRO_TPU_CAPACITY_PERSIST="1",
+    )
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.join(here, "scripts", "north_star.py"),
+             "--mode", "mesh", "--rows", str(rows)],
+            env=env, capture_output=True, text=True, timeout=1800,
+        )
+    except (OSError, subprocess.TimeoutExpired) as e:
+        _log(f"[bench] mesh leg failed to run: {e!r}")
+        return
+    lines = [ln for ln in proc.stdout.splitlines()
+             if ln.strip().startswith("{")]
+    if proc.returncode != 0 or not lines:
+        _log(f"[bench] mesh leg failed rc={proc.returncode}: "
+             f"{proc.stderr[-400:]}")
+        return
+    entry = json.loads(lines[-1])
+    details["mesh"] = entry
+    ph = entry.get("phases", {})
+    _log(f"[bench] mesh[8-dev spoofed] {entry.get('rows')} rows: "
+         f"warm {entry.get('decode_s')}s (cold {entry.get('decode_cold_s')}s"
+         f" incl. compile {entry.get('compile_s')}s), "
+         f"retries {entry.get('warm_retries')}, "
+         f"pack {ph.get('pack_s')}s h2d {ph.get('h2d_s')}s "
+         f"launch {ph.get('launch_s')}s d2h {ph.get('d2h_s')}s, "
+         f"overlap {ph.get('overlap_frac')}")
 
 
 def _bench_pyfallback(schema, datums, reps, details):

@@ -24,7 +24,12 @@ from ..fallback.io import MalformedAvro, shift_malformed
 from ..schema.cache import SchemaEntry
 from . import UnsupportedOnDevice
 from .arrow_build import compact_union_slices
-from .decode import BatchTooLarge, DeviceCapacityExceeded, DeviceDecoder
+from .decode import (
+    BatchTooLarge,
+    DeviceCapacityExceeded,
+    DeviceDecoder,
+    overlap_chunks,
+)
 
 __all__ = ["DeviceCodec", "get_device_codec"]
 
@@ -243,12 +248,25 @@ class DeviceCodec:
             data, self.ir, self.arrow_schema, reader
         )
 
+    def _decode_triples(self, data: Sequence[bytes]):
+        """One or more ``(host_columns, rows, meta)`` triples: large
+        batches on the XLA pipeline run the double-buffered overlap
+        path (pack+h2d of chunk N+1 concurrent with chunk N's launch —
+        ISSUE 10, ``PYRUHVRO_TPU_OVERLAP`` / ``_OVERLAP_ROWS`` knobs);
+        everything else stays single-launch."""
+        dec = self.decoder
+        if isinstance(dec, DeviceDecoder):
+            k = overlap_chunks(len(data))
+            if k > 1:
+                return dec.decode_to_columns_overlapped(data, k)
+        return [dec.decode_to_columns(data)]
+
     def decode(self, data: Sequence[bytes]) -> pa.RecordBatch:
         if len(data) == 0:
             # empty launch has no shapes to compile; build directly
             return self._host_decode([])
         try:
-            host, n, meta = self.decoder.decode_to_columns(data)
+            triples = self._decode_triples(data)
         except BatchTooLarge:
             # one launch is bounded to 1 GiB of datum bytes (int32
             # cursors): recursively halve the batch — each half still
@@ -288,7 +306,11 @@ class DeviceCodec:
         from .arrow_build import build_record_batch
 
         _device_call_ok()
-        return build_record_batch(self.ir, self.arrow_schema, host, n, meta)
+        batches = [
+            build_record_batch(self.ir, self.arrow_schema, host, n, meta)
+            for host, n, meta in triples
+        ]
+        return batches[0] if len(batches) == 1 else _concat_batches(batches)
 
     def _sharded_decoder(self):
         """The mesh-sharded decoder when >1 device is attached, else None

@@ -33,7 +33,14 @@ import numpy as np
 import time
 
 from ..fallback.io import MalformedAvro, malformed_record
-from ..runtime import deadline, device_obs, faults, metrics, telemetry
+from ..runtime import (
+    capacity,
+    deadline,
+    device_obs,
+    faults,
+    metrics,
+    telemetry,
+)
 from ..runtime.pack import bucket_len, concat_records
 from .fieldprog import ROWS, Program, lower
 from .varint import ERR_ITEM_OVERFLOW, ERR_NAMES, ERR_SLUGS
@@ -45,7 +52,53 @@ __all__ = [
     "split_blob",
     "pad_views",
     "pack_launch_input",
+    "pack_launch_into",
+    "overlap_chunks",
 ]
+
+
+def raise_aggregated_malformed(indices) -> None:
+    """Raise ONE :class:`MalformedAvro` for a multi-chunk / multi-shard
+    decode: the message names the FIRST bad global row, ``indices``
+    carries every ``(global index, slug)`` pair — the shape the
+    tolerant api path consumes to quarantine all offenders in a single
+    relaunch. Shared by the overlap path and ``parallel/sharded.py``."""
+    indices = sorted(indices)
+    i0, slug0 = indices[0]
+    bit0 = {v: k for k, v in ERR_SLUGS.items()}.get(slug0, 0)
+    raise malformed_record(
+        i0, ERR_NAMES.get(bit0, slug0), err_name=slug0,
+        tier="device", indices=indices,
+    )
+
+
+def _ready(res) -> bool:
+    """Has an in-flight device result completed? (Conservative: an
+    array without ``is_ready`` counts as done, so overlap accounting
+    can only undercount on backends missing the API.)"""
+    try:
+        return bool(res.is_ready())
+    except AttributeError:
+        return True
+
+
+def overlap_chunks(n_rows: int) -> int:
+    """How many sub-batches the double-buffered h2d/compute overlap
+    path should pipeline a decode through (1 = stay on the single-launch
+    path). ``PYRUHVRO_TPU_OVERLAP=0`` disables; ``PYRUHVRO_TPU_OVERLAP_ROWS``
+    (default 4096) is the minimum rows per chunk — chunks below it
+    would pay more per-launch overhead than the overlap hides."""
+    import os
+
+    if os.environ.get("PYRUHVRO_TPU_OVERLAP", "").strip() in ("0", "off"):
+        return 1
+    try:
+        min_rows = int(os.environ.get("PYRUHVRO_TPU_OVERLAP_ROWS", "")
+                       or 4096)
+    except ValueError:
+        min_rows = 4096
+    min_rows = max(1, min_rows)
+    return max(1, min(8, n_rows // min_rows))
 
 
 def split_blob(blob: np.ndarray, layout) -> Dict[str, np.ndarray]:
@@ -123,6 +176,35 @@ def pack_launch_input(words, starts, lengths, n: int) -> np.ndarray:
         lengths.view(np.uint32),
         np.array([n], np.uint32),
     ])
+
+
+def pack_launch_into(out: np.ndarray, flat: np.ndarray,
+                     offsets: np.ndarray, n: int, R: int, B: int
+                     ) -> np.ndarray:
+    """In-place :func:`pack_launch_input`: write the packed
+    ``[words | starts | lengths | n]`` launch buffer for one record run
+    directly into ``out`` (a persistent per-(R, B) host arena, length
+    ``B // 4 + 2 * R + 1`` u32) — the warm path allocates nothing.
+    ``flat``/``offsets`` are :func:`..runtime.pack.concat_records`
+    output (or a slice of one: ``offsets`` may start non-zero)."""
+    W = B // 4
+    base = int(offsets[0])
+    total = int(offsets[-1]) - base
+    u8 = out[:W].view(np.uint8)
+    u8[:total] = flat[:total]
+    u8[total:] = 0
+    starts = out[W : W + R].view(np.int32)
+    starts[:] = B
+    # subtract in int64 BEFORE the int32 store: a shard whose absolute
+    # base offset crosses 2 GiB would overflow an in-place int32 -=
+    # (numpy 2.x raises); the shard-local results always fit int32
+    starts[:n] = offsets[:-1] - base
+    lengths = out[W + R : W + 2 * R].view(np.int32)
+    lengths[n:] = 0
+    np.subtract(offsets[1:], offsets[:-1], out=lengths[:n],
+                casting="unsafe")
+    out[W + 2 * R] = n
+    return out
 
 
 def unpack_launch_input(jnp, lax, buf, W: int, R: int):
@@ -214,8 +296,17 @@ class DeviceDecoder:
         self.prog: Program = lower(ir)
         self.backend = backend
         # schema id for the jit-cache registry / recompile-churn guard
-        # (codec.py passes the SchemaEntry fingerprint down)
-        self.fingerprint = fingerprint or "?"
+        # (codec.py passes the SchemaEntry fingerprint down). Decoders
+        # built straight from an IR (ShardedDecoder(ir), tests, bench
+        # scripts) get a stable IR-derived fallback so the capacity
+        # planner can still key their learned rungs across processes.
+        if not fingerprint:
+            import hashlib
+
+            fingerprint = "ir:" + hashlib.sha1(
+                repr(ir).encode()
+            ).hexdigest()[:12]
+        self.fingerprint = fingerprint
         self._pipe_cache: Dict[tuple, tuple] = {}
         self._err_cache: Dict[tuple, object] = {}
         self._item_caps: List[int] = [0] + [
@@ -228,7 +319,46 @@ class DeviceDecoder:
         # full-width layout (see build_pipeline blob shrinking)
         self._str_full: set = set()
         self._seed_tried: set = set()  # (R, rid) sampling attempts
+        # persistent host input arenas: (R, B, slot) -> u32 buffer the
+        # packer refills in place (slot alternates 0/1 on the
+        # double-buffered overlap path; the single-launch path uses 0)
+        self._arenas: Dict[tuple, np.ndarray] = {}
+        # R buckets whose converged rung was already taught to the
+        # capacity planner (re-harvest only after a cap actually grows)
+        self._planned: set = set()
         self._lock = threading.Lock()
+
+    def _arena(self, R: int, B: int, slot: int = 0) -> np.ndarray:
+        """The persistent packed-input host buffer for an (R, B) bucket
+        — identity-stable across warm calls (no per-call allocation;
+        the donation/arena-reuse test asserts on ``ctypes.data``).
+
+        Keyed by thread too: the codec is memoized per schema for the
+        process lifetime, so two threads decoding same-bucket batches
+        concurrently would otherwise overwrite each other's packed
+        bytes between pack and ``device_put`` (the pre-arena code was
+        race-free by allocating per call; per-thread arenas restore
+        that invariant at per-thread cost)."""
+        key = (R, B, slot, threading.get_ident())
+        with self._lock:
+            buf = self._arenas.get(key)
+            if buf is None:
+                # bound lifetime growth: a decoder lives as long as the
+                # process, so keep only the LARGEST B per (R, slot,
+                # thread) — smaller byte buckets of the same row bucket
+                # are superseded, and this thread cannot be mid-call on
+                # one (calls are synchronous per thread)
+                for old in [k for k in self._arenas
+                            if k[0] == R and k[2] == slot
+                            and k[3] == key[3] and k[1] < B]:
+                    del self._arenas[old]
+                buf = self._arenas[key] = np.empty(
+                    B // 4 + 2 * R + 1, np.uint32
+                )
+                metrics.inc("device.arena.misses")
+            else:
+                metrics.inc("device.arena.hits")
+        return buf
 
     # -- traced pieces -----------------------------------------------------
 
@@ -482,13 +612,21 @@ class DeviceDecoder:
         def packed(buf):
             return pipeline(*unpack_launch_input(jnp, lax, buf, W, R))
 
+        # donate_argnums: the packed input buffer is consumed by the
+        # launch, so XLA recycles its device memory for the outputs
+        # instead of allocating a fresh blob per call (ISSUE 10 —
+        # callers must treat the device input as dead after the call;
+        # the capacity ladder re-puts from the host arena on a retry
+        # rung). Where donation cannot be used XLA only warns, and the
+        # InstrumentedJit compile paths scope that warning away.
         # jit-cache telemetry (ISSUE 5): each cache entry is one
         # executable; the wrapper splits its first call into an explicit
         # lower+compile (device.compile_s) and times every later call as
         # device.launch_s, feeding the per-(fingerprint, bucket) registry
         # and the recompile-churn guard
         fn = device_obs.InstrumentedJit(
-            self._jax, self._jax.jit(packed), kind="decode.pipeline",
+            self._jax, self._jax.jit(packed, donate_argnums=0),
+            kind="decode.pipeline",
             bucket=_bucket_label(R, B, item_caps, tot_caps,
                                  compact_strings),
             fingerprint=self.fingerprint, family="decode",
@@ -641,32 +779,68 @@ class DeviceDecoder:
                              op="decode"):
             return self._decode_to_columns(data)
 
-    def _decode_to_columns(self, data: Sequence[bytes]):
+    def seed_from_plan(self, R: int) -> bool:
+        """Warm-start an R bucket from the capacity planner's learned
+        rung (ISSUE 10): a schema any decoder has converged before —
+        this process or, via ROUTING_PROFILE.json, a previous one —
+        compiles once and launches with ``device.retries == 0`` from
+        its very first call. Returns True on a plan hit (the host
+        sample probe is skipped too; the plan replaces it). A hit also
+        marks the bucket planned, so the overlap path streams ALL
+        chunks from the first call instead of sync-laddering chunk 0
+        against a rung the planner already proved."""
+        hit = capacity.seed_decoder(self, R)
+        if hit:
+            with self._lock:
+                self._planned.add(R)
+        return hit
+
+    def _harvest_plan(self, R: int, grew: bool) -> None:
+        """Teach the planner this bucket's converged rung (once per
+        bucket unless a cap actually grew) and arm profile persistence
+        when capacity persistence is enabled."""
+        with self._lock:
+            fresh = R not in self._planned
+            self._planned.add(R)
+        if not (fresh or grew):
+            return
+        capacity.harvest_decoder(self, R)
+        if capacity.persist_enabled():
+            from ..runtime import costmodel
+
+            costmodel.arm_persistence()
+
+    def _arena_views(self, arena: np.ndarray, R: int, B: int):
+        """(words, starts, lengths) views over a packed arena — the
+        rare error pass re-puts these individually."""
+        W = B // 4
+        return (arena[:W], arena[W : W + R].view(np.int32),
+                arena[W + R : W + 2 * R].view(np.int32))
+
+    def _put_packed(self, arena: np.ndarray):
+        """One transfer of the packed arena (h2d span + byte counters)."""
         jax = self._jax
-        n = len(data)
-        with telemetry.phase("decode.pack_s", rows=n):
-            flat, offsets = concat_records(data)
-        total = int(offsets[-1])
-        if total > (1 << 30):
-            # int32 cursors bound one launch to 1 GiB of datum bytes; the
-            # codec catches this and auto-splits the batch (codec.py)
-            raise BatchTooLarge(n, total)
-        B = bucket_len(max(total, 4), minimum=16)
-        R = bucket_len(max(n, 1), minimum=8)
-        self.seed_caps_from_sample(data, R)
-        words, starts, lengths, flat = pad_views(flat, offsets, n, R, B)
-        packed = pack_launch_input(words, starts, lengths, n)
-
-        with telemetry.phase("decode.h2d_s", bytes=packed.nbytes):
+        with telemetry.phase("decode.h2d_s", bytes=arena.nbytes):
             faults.fire("h2d")
-            packed_d = jax.device_put(packed)
-        metrics.inc("decode.h2d_bytes", packed.nbytes)
-        metrics.inc("device.h2d_bytes", packed.nbytes)
+            packed_d = jax.device_put(arena)
+        metrics.inc("decode.h2d_bytes", arena.nbytes)
+        metrics.inc("device.h2d_bytes", arena.nbytes)
+        return packed_d
 
+    def _run_ladder(self, arena: np.ndarray, R: int, B: int,
+                    packed_d=None):
+        """Launch the pipeline for one packed arena, climbing the
+        capacity ladder until the reductions converge. Returns the
+        split-but-unexpanded host dict. ``packed_d`` (optional) is an
+        already-transferred device buffer for the FIRST rung; donation
+        consumes it, so retry rungs re-put from the host arena."""
+        jax = self._jax
         prog = self.prog
         host = None
-        # zero-byte items (null / empty-record) reveal their true count only
-        # ~cap-at-a-time, so cap growth can take ~log2(_MAX_ITEM_CAP) rounds
+        grew = False
+        # zero-byte items (null / empty-record) reveal their true count
+        # only ~cap-at-a-time, so cap growth can take ~log2(_MAX_ITEM_CAP)
+        # rounds
         for _attempt in range(24):
             # each capacity-ladder rung is a compile + launch: a
             # deadline-bounded call stops climbing when the budget is
@@ -676,12 +850,18 @@ class DeviceDecoder:
             compact = (R, B) not in self._str_full
             fn, layout = self._pipeline_fn(R, B, item_caps, tot_caps,
                                            compact)
+            if packed_d is None or getattr(packed_d, "is_deleted",
+                                           lambda: True)():
+                # the previous rung's donated input was consumed (or
+                # this is the first rung): transfer from the host arena
+                packed_d = self._put_packed(arena)
             # the wrapper splits device.compile_s (first call per shape
             # bucket, explicit lower+compile) from device.launch_s
             # (block_until_ready-bounded unless behind a remote
             # interconnect — device_obs.sync_mode); d2h_s carries any
             # remaining wait
             res = fn(packed_d)
+            packed_d = None  # donated: dead after the launch
             with telemetry.phase("decode.d2h_s"):
                 blob = np.asarray(jax.device_get(res))
             metrics.inc("decode.d2h_bytes", blob.nbytes)
@@ -691,6 +871,7 @@ class DeviceDecoder:
                 # a string overflowed the compact descriptor budget:
                 # remember and relaunch this bucket full-width
                 self._str_full.add((R, B))
+                grew = True
                 metrics.inc("device.retries")
                 telemetry.observe(
                     "device.retry_s", 0.0,
@@ -712,6 +893,7 @@ class DeviceDecoder:
             t0 = time.perf_counter()
             if not self.grow_caps(R, item_caps, tot_caps, red_max, red_sum):
                 break
+            grew = True
             # each retry-ladder rung is a child span carrying WHY the
             # relaunch happened and the capacity that proved too small
             metrics.inc("device.retries")
@@ -724,47 +906,279 @@ class DeviceDecoder:
             )
         else:
             raise MalformedAvro("array/map item capacity did not converge")
+        self._harvest_plan(R, grew)
+        return host
 
-        # per-device memory watermarks where the backend exposes them
-        # (TPU/GPU memory_stats(); graceful no-op on CPU)
-        device_obs.note_memory(jax)
-
-        host = self.expand_host(host)
-        if host["#red:err"][0]:
-            # rare path (malformed batch): re-put the unpacked inputs for
-            # the walk-only error pass
-            err = np.asarray(
-                jax.device_get(
-                    self._err_fn(R, B, item_caps)(
-                        jax.device_put(words),
-                        jax.device_put(starts),
-                        jax.device_put(lengths),
-                        np.int32(n),
-                    )
+    def _raise_row_errors(self, arena, R, B, n, base_row: int = 0,
+                          collect=None):
+        """Run the walk-only error pass for one packed arena and either
+        raise (default) or append ``(global_index, slug)`` pairs into
+        ``collect`` (the overlap path aggregates across chunks first)."""
+        jax = self._jax
+        item_caps, _tot = self.caps_snapshot(R)
+        words, starts, lengths = self._arena_views(arena, R, B)
+        err = np.asarray(
+            jax.device_get(
+                self._err_fn(R, B, item_caps)(
+                    jax.device_put(words),
+                    jax.device_put(starts),
+                    jax.device_put(lengths),
+                    np.int32(n),
                 )
-            )[:n]
-            bad = err & ~np.uint32(ERR_ITEM_OVERFLOW)
-            bad_rows = np.flatnonzero(bad)
-            # the walk computed error bits for EVERY lane — surface the
-            # full row mask so a tolerant caller (api.py on_error=skip/
-            # null) isolates all offenders in ONE extra pass instead of
-            # re-launching once per bad record
-            indices = []
-            for r in bad_rows:
-                v = int(bad[int(r)])
-                b = v & -v
-                indices.append((int(r), ERR_SLUGS.get(b, f"bit_{b:#x}")))
-            i = int(bad_rows[0])
-            v = int(bad[i])
-            bit = v & -v
-            raise malformed_record(
-                i, ERR_NAMES.get(bit, f"error bit {bit:#x}"),
-                err_name=ERR_SLUGS.get(bit, f"bit_{bit:#x}"),
-                tier="device", indices=indices,
             )
+        )[:n]
+        bad = err & ~np.uint32(ERR_ITEM_OVERFLOW)
+        bad_rows = np.flatnonzero(bad)
+        # the walk computed error bits for EVERY lane — surface the
+        # full row mask so a tolerant caller (api.py on_error=skip/
+        # null) isolates all offenders in ONE extra pass instead of
+        # re-launching once per bad record
+        indices = []
+        for r in bad_rows:
+            v = int(bad[int(r)])
+            b = v & -v
+            indices.append(
+                (base_row + int(r), ERR_SLUGS.get(b, f"bit_{b:#x}"))
+            )
+        if collect is not None:
+            collect.extend(indices)
+            return
+        if not indices:  # pragma: no cover — err flag implies a bad lane
+            raise MalformedAvro("device reported a malformed record")
+        i = int(bad_rows[0])
+        v = int(bad[i])
+        bit = v & -v
+        raise malformed_record(
+            base_row + i, ERR_NAMES.get(bit, f"error bit {bit:#x}"),
+            err_name=ERR_SLUGS.get(bit, f"bit_{bit:#x}"),
+            tier="device", indices=indices,
+        )
 
+    def _finish_host(self, host, n, flat):
+        """Expand a converged host dict and build the (host, n, meta)
+        triple — shared by the single-launch and overlap paths."""
+        prog = self.prog
+        host = self.expand_host(host)
         meta = {"item_totals": {}, "flat": flat}
         for rid, path in enumerate(prog.regions):
             if rid != ROWS:
                 meta["item_totals"][path] = int(host["#red:sum:" + path][0])
         return host, n, meta
+
+    def _decode_to_columns(self, data: Sequence[bytes]):
+        jax = self._jax
+        n = len(data)
+        with telemetry.phase("decode.pack_s", rows=n):
+            flat, offsets = concat_records(data)
+        total = int(offsets[-1])
+        if total > (1 << 30):
+            # int32 cursors bound one launch to 1 GiB of datum bytes; the
+            # codec catches this and auto-splits the batch (codec.py)
+            raise BatchTooLarge(n, total)
+        B = bucket_len(max(total, 4), minimum=16)
+        R = bucket_len(max(n, 1), minimum=8)
+        if not self.seed_from_plan(R):
+            self.seed_caps_from_sample(data, R)
+        arena = self._arena(R, B)
+        pack_launch_into(arena, flat, offsets, n, R, B)
+
+        host = self._run_ladder(arena, R, B)
+
+        # per-device memory watermarks where the backend exposes them
+        # (TPU/GPU memory_stats(); graceful no-op on CPU)
+        device_obs.note_memory(jax)
+
+        if host["#red:err"][0]:
+            # rare path (malformed batch): re-put the arena views for
+            # the walk-only error pass
+            self._raise_row_errors(arena, R, B, n)
+        return self._finish_host(host, n, flat)
+
+    # -- double-buffered h2d/compute overlap (ISSUE 10) --------------------
+
+    def decode_to_columns_overlapped(self, data: Sequence[bytes],
+                                     n_chunks: int):
+        """Pipelined chunked decode: pack + ``device_put`` of chunk
+        N+1 runs on the host while chunk N's launch is in flight
+        (async dispatch; the only blocking point is each chunk's d2h).
+        Returns one ``(host_columns, rows, meta)`` triple per chunk.
+
+        ``device.overlap_s`` accumulates the host-side pack/h2d seconds
+        spent while at least one launch was in flight — the overlap the
+        serialized pipeline of PR 5's spans could only *measure*;
+        ``device.overlap_frac`` (per call, on the span) is that time
+        over the whole pipeline wall."""
+        with telemetry.phase("device.pipeline_s", rows=len(data),
+                             op="decode", overlap_chunks=n_chunks):
+            return self._decode_overlapped(data, n_chunks)
+
+    def _decode_overlapped(self, data: Sequence[bytes], n_chunks: int):
+        from ..runtime.chunking import chunk_bounds
+
+        jax = self._jax
+        n_all = len(data)
+        t_wall0 = time.perf_counter()
+        with telemetry.phase("decode.pack_s", rows=n_all):
+            flat_all, offsets_all = concat_records(data)
+        bounds = chunk_bounds(n_all, n_chunks)
+        chunk_rows = max(b - a for a, b in bounds)
+        chunk_bytes = max(
+            int(offsets_all[b] - offsets_all[a]) for a, b in bounds
+        )
+        if int(offsets_all[-1]) > (1 << 30) or chunk_bytes > (1 << 30):
+            raise BatchTooLarge(n_all, int(offsets_all[-1]))
+        R = bucket_len(max(chunk_rows, 1), minimum=8)
+        B = bucket_len(max(chunk_bytes, 4), minimum=16)
+        if not self.seed_from_plan(R):
+            self.seed_caps_from_sample(data, R)
+
+        chunk_arenas: dict = {}  # chunk index -> its (reused) arena
+
+        def pack_chunk(i: int) -> np.ndarray:
+            a, b = bounds[i]
+            arena = self._arena(R, B, slot=i % 2)
+            base = int(offsets_all[a])
+            pack_launch_into(
+                arena, flat_all[base : int(offsets_all[b])],
+                offsets_all[a : b + 1], b - a, R, B,
+            )
+            chunk_arenas[i] = arena
+            return arena
+
+        def chunk_flat(i: int) -> np.ndarray:
+            a, b = bounds[i]
+            return flat_all[int(offsets_all[a]) : int(offsets_all[b])]
+
+        triples = [None] * len(bounds)
+        bad_indices: list = []
+        # COLD bucket: chunk 0 converges the capacity ladder
+        # synchronously first (the cold rungs must not be pipelined —
+        # every later chunk reuses its compiled executable and caps).
+        # WARM bucket (converged before, or planner-seeded): chunk 0
+        # joins the async stream too, so even a 2-chunk call overlaps
+        # pack/h2d with a launch in flight.
+        with self._lock:
+            warm = R in self._planned
+        start = 0
+        if not warm:
+            arena0 = pack_chunk(0)
+            host0 = self._run_ladder(arena0, R, B)
+            if host0["#red:err"][0]:
+                self._raise_row_errors(
+                    arena0, R, B, bounds[0][1] - bounds[0][0],
+                    base_row=0, collect=bad_indices,
+                )
+            triples[0] = self._finish_host(
+                host0, bounds[0][1] - bounds[0][0], chunk_flat(0)
+            )
+            start = 1
+
+        overlap_s = 0.0
+        # (chunk index, in-flight result, layout/caps AT DISPATCH TIME —
+        # a later rerun may grow the shared caps under a pending chunk)
+        pending: list = []
+
+        def collect_one():
+            """Block on the OLDEST in-flight chunk and post-process it
+            (rare per-chunk cap overflow re-runs the ladder — its input
+            arena is still intact: only chunk i+2 would reuse the slot,
+            and it is never packed before chunk i is collected)."""
+            i, res, layout, item_caps, tot_caps, compact = pending.pop(0)
+            a, b = bounds[i]
+            with telemetry.phase("decode.d2h_s"):
+                blob = np.asarray(jax.device_get(res))
+            metrics.inc("decode.d2h_bytes", blob.nbytes)
+            metrics.inc("device.d2h_bytes", blob.nbytes)
+            host = split_blob(blob, layout)
+            prog = self.prog
+            needs_rerun = (
+                compact and "#red:strfit" in host
+                and not host["#red:strfit"][0]
+            )
+            if needs_rerun:
+                # record the overflow NOW so the rerun ladder goes
+                # straight to the full-width layout instead of paying
+                # one more known-failing compact launch
+                self._str_full.add((R, B))
+                metrics.inc("device.retries")
+                telemetry.observe(
+                    "device.retry_s", 0.0,
+                    reason="str_descriptor_overflow",
+                    capacity=_bucket_label(R, B, item_caps, tot_caps,
+                                           compact),
+                )
+            if not needs_rerun:
+                red_max = {
+                    rid: int(host["#red:max:" + path][0])
+                    for rid, path in enumerate(prog.regions)
+                    if rid != ROWS
+                }
+                red_sum = {
+                    rid: int(host["#red:sum:" + path][0])
+                    for rid, path in enumerate(prog.regions)
+                    if rid != ROWS
+                }
+                if self.grow_caps(R, item_caps, tot_caps,
+                                  red_max, red_sum):
+                    # heterogeneous chunk overflowed chunk 0's rung: a
+                    # genuine retry relaunch — counted HERE, because
+                    # the rerun ladder starts at the already-grown caps
+                    # and would record nothing itself
+                    needs_rerun = True
+                    metrics.inc("device.retries")
+                    telemetry.observe(
+                        "device.retry_s", 0.0, reason="cap_growth",
+                        capacity=_bucket_label(R, B, item_caps,
+                                               tot_caps, compact),
+                        need_items=max(red_max.values(), default=0),
+                        need_total=max(red_sum.values(), default=0),
+                    )
+            if needs_rerun:
+                host = self._run_ladder(chunk_arenas[i], R, B)
+            if host["#red:err"][0]:
+                self._raise_row_errors(
+                    chunk_arenas[i], R, B, b - a,
+                    base_row=a, collect=bad_indices,
+                )
+            triples[i] = self._finish_host(host, b - a, chunk_flat(i))
+
+        for i in range(start, len(bounds)):
+            t0 = time.perf_counter()
+            arena = pack_chunk(i)
+            packed_d = self._put_packed(arena)
+            t_host = time.perf_counter() - t0
+            if any(not _ready(res) for _j, res, *_rest in pending):
+                # a launch is STILL in flight after this chunk's whole
+                # pack+h2d finished: every one of those host seconds ran
+                # concurrently with device compute. (Checking AFTER the
+                # host work undercounts the tail — a launch completing
+                # mid-pack — so the figure is conservative, never
+                # fiction.)
+                overlap_s += t_host
+            item_caps, tot_caps = self.caps_snapshot(R)
+            compact = (R, B) not in self._str_full
+            fn, layout = self._pipeline_fn(R, B, item_caps, tot_caps,
+                                           compact)
+            # call_async skips the sync_mode block: the launch stays in
+            # flight while the next chunk packs; collect_one's d2h
+            # carries the wait
+            res = fn.call_async(packed_d)
+            pending.append((i, res, layout, item_caps, tot_caps, compact))
+            if len(pending) >= 2:
+                collect_one()
+        while pending:
+            collect_one()
+
+        device_obs.note_memory(jax)
+        if bad_indices:
+            raise_aggregated_malformed(bad_indices)
+        wall = time.perf_counter() - t_wall0
+        if overlap_s:
+            metrics.inc("device.overlap_s", overlap_s)
+            metrics.inc("device.overlap_calls")
+            telemetry.annotate(
+                overlap_s=round(overlap_s, 6),
+                overlap_frac=round(min(overlap_s / wall, 1.0), 4)
+                if wall > 0 else 0.0,
+            )
+        return triples

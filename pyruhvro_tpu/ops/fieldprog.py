@@ -113,16 +113,25 @@ class _Ctx:
     lower: the default is a masked scatter (XLA handles those well in
     HBM); the Pallas kernel supplies a 2D one-hot select strategy
     instead, because Mosaic does not lower vector-index scatters
-    (``ops/pallas_decode.py``)."""
+    (``ops/pallas_decode.py``).
 
-    __slots__ = ("words", "ends", "item_caps", "item_put")
+    ``reduce_max`` (optional) overrides how the repeated emitter's
+    scalar loop-bound reduction lowers: the default ``jnp.max`` is an
+    integer ``reduce_max``, which Mosaic refuses to lower (the 12
+    failures recorded in PALLAS_LOWER_STATS.json pre-ISSUE-10); the
+    Pallas kernel supplies a float32 round trip instead — exact for the
+    record-local byte spans the bound is computed over (≤ BW·4 ≤ 2 KiB,
+    far inside float32's 2^24 integer range)."""
+
+    __slots__ = ("words", "ends", "item_caps", "item_put", "reduce_max")
 
     def __init__(self, words, ends, item_caps: Tuple[int, ...],
-                 item_put=None):
+                 item_put=None, reduce_max=None):
         self.words = words
         self.ends = ends          # absolute end index per row lane
         self.item_caps = item_caps  # static cap per region (item_caps[0] unused)
         self.item_put = item_put
+        self.reduce_max = reduce_max if reduce_max is not None else jnp.max
 
 
 def _put(st, key, idx, val, mask, cx=None):
@@ -344,8 +353,11 @@ class _Lowering:
             present = mask & (branch == (1 - null_idx))
             absent = mask & (branch == null_idx)
             st = _err_where(st, mask & ~(present | absent), ERR_BAD_BRANCH)
+            # i32 constant on purpose: _put casts to the buffer dtype,
+            # and a literal u8 constant is unlowerable in Mosaic (the
+            # Pallas kernel widens u8 buffers to i32 in-kernel)
             st = _put(st, path + "#valid", out_idx,
-                      jnp.full_like(branch, 1, dtype=jnp.uint8), present, cx)
+                      jnp.full_like(branch, 1, dtype=I32), present, cx)
             return inner(cx, st, present, out_idx)
 
         return emit_nullable
@@ -429,7 +441,7 @@ class _Lowering:
             # bounded by the per-record cap — an overflowing cap retries
             # with a larger one, see ops/decode.py)
             row_span = cx.ends - st["#cursor"]
-            max_iters = jnp.max(jnp.where(mask, row_span, 0)) + icap + 2
+            max_iters = cx.reduce_max(jnp.where(mask, row_span, 0)) + icap + 2
 
             def cond(carry):
                 _st, _rem, done, _cnt, it = carry

@@ -92,13 +92,20 @@ class _TileWords:
         w = jnp.clip(widx, 0, bw - 1)
         col = jax.lax.broadcasted_iota(jnp.int32, (tile_r, bw), 1)
         hot = col == w[:, None]
-        # reduce in int32: Mosaic has no unsigned reductions, and with
-        # exactly one non-zero term per row the i32 sum is bit-exact
-        picked = jnp.where(
-            hot, jax.lax.bitcast_convert_type(tile, jnp.int32), 0
-        )
+        # Mosaic lowers no integer reductions at all (reduce_sum over
+        # i32 was the bulk of the 12 PALLAS_LOWER_STATS failures), so
+        # the one-hot row-reduction runs as TWO float32 sums over the
+        # word's 16-bit halves: each half is < 2^16 and exactly one
+        # term per row is non-zero, so both f32 sums are bit-exact and
+        # recombine to the original u32 word. (f32→i32 casts lower;
+        # f32→u32 does not — keep the integer math in i32 throughout.)
+        ti = jax.lax.bitcast_convert_type(tile, jnp.int32)
+        lo = jnp.where(hot, ti & 0xFFFF, 0)
+        hi = jnp.where(hot, jax.lax.shift_right_logical(ti, 16), 0)
+        slo = jnp.sum(lo.astype(jnp.float32), axis=1).astype(jnp.int32)
+        shi = jnp.sum(hi.astype(jnp.float32), axis=1).astype(jnp.int32)
         return jax.lax.bitcast_convert_type(
-            jnp.sum(picked, axis=1, dtype=jnp.int32), jnp.uint32
+            slo | (shi << 16), jnp.uint32
         )
 
 
@@ -202,8 +209,14 @@ class PallasKernelDecoder:
                 st[key] = jnp.zeros(
                     self._buf_len(key, tile_r, caps), kdt
                 )
+            def reduce_max_f32(v):
+                # scalar loop-bound max over record-local byte spans
+                # (≤ BW·4 ≤ 2 KiB — exact in float32); Mosaic refuses
+                # the integer reduce_max this replaces
+                return jnp.max(v.astype(jnp.float32)).astype(jnp.int32)
+
             cx = _Ctx(_TileWords(tile, jax), lens, item_caps=caps,
-                      item_put=item_put)
+                      item_put=item_put, reduce_max=reduce_max_f32)
             st = prog.emit(cx, st, active, None)
             st["#err"] = st["#err"] | jnp.where(
                 active & (st["#cursor"] != lens),

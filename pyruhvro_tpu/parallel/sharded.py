@@ -23,18 +23,19 @@ from typing import Dict, List, Optional, Sequence, Tuple
 import numpy as np
 import pyarrow as pa
 
-from ..fallback.io import MalformedAvro, malformed_record
+from ..fallback.io import MalformedAvro
 from ..ops.decode import (
     BatchTooLarge,
     DeviceDecoder,
     _bucket_label,
-    pack_launch_input,
-    pad_views,
+    _ready,
+    pack_launch_into,
+    raise_aggregated_malformed,
     split_blob,
     unpack_launch_input,
 )
 from ..ops.fieldprog import ROWS
-from ..ops.varint import ERR_ITEM_OVERFLOW, ERR_NAMES, ERR_SLUGS
+from ..ops.varint import ERR_ITEM_OVERFLOW, ERR_SLUGS
 from ..runtime import device_obs, metrics, telemetry
 from ..runtime.chunking import chunk_bounds
 from ..runtime.pack import bucket_len, concat_records
@@ -88,7 +89,32 @@ class ShardedDecoder:
         )
         self.D = int(self.mesh.devices.size)
         self._cache: Dict[tuple, tuple] = {}
+        # persistent [D, W + 2R + 1] packed-input host arenas, one per
+        # (R, B) bucket (the sharded mirror of DeviceDecoder._arena)
+        self._arenas: Dict[tuple, np.ndarray] = {}
         self._lock = threading.Lock()
+
+    def _arena(self, R: int, B: int) -> np.ndarray:
+        # thread-keyed like DeviceDecoder._arena: concurrent callers of
+        # one memoized codec must not overwrite each other's packed
+        # bytes between pack and device_put
+        key = (R, B, threading.get_ident())
+        with self._lock:
+            buf = self._arenas.get(key)
+            if buf is None:
+                # keep only the largest B per (R, thread) — bounds
+                # process-lifetime arena memory (see DeviceDecoder._arena)
+                for old in [k for k in self._arenas
+                            if k[0] == R and k[2] == key[2]
+                            and k[1] < B]:
+                    del self._arenas[old]
+                buf = self._arenas[key] = np.empty(
+                    (self.D, B // 4 + 2 * R + 1), np.uint32
+                )
+                metrics.inc("device.arena.misses")
+            else:
+                metrics.inc("device.arena.hits")
+        return buf
 
     # -- compiled sharded launch ------------------------------------------
 
@@ -129,8 +155,13 @@ class ShardedDecoder:
             fn = smap(per_shard, check_vma=False, **kwargs)
         except TypeError:
             fn = smap(per_shard, check_rep=False, **kwargs)
+        # the packed shard buffer is donated like the single-device
+        # input (ISSUE 10): XLA recycles its memory for the [D, blob]
+        # outputs; capacity-ladder retries re-put from the host arena
+        # (the "donation not usable" warning is scoped away inside the
+        # InstrumentedJit compile paths)
         inst = device_obs.InstrumentedJit(
-            jax, jax.jit(fn), kind="decode.sharded",
+            jax, jax.jit(fn, donate_argnums=0), kind="decode.sharded",
             bucket=f"D{self.D}," + _bucket_label(R, B, item_caps,
                                                  tot_caps, compact),
             fingerprint=self.base.fingerprint, family="decode",
@@ -164,51 +195,99 @@ class ShardedDecoder:
         while len(bounds) < self.D:
             bounds.append((n_all, n_all))
 
+        jax = self._jax
+        time0 = time.perf_counter()
+        # ONE flat concat of the whole batch (C++ shim, GIL released);
+        # shards are slices of it — per-shard concat_records would walk
+        # the datum list D times
         with telemetry.phase("decode.pack_s", rows=n_all):
-            packs = []
-            for a, b in bounds:
-                flat, offsets = concat_records(data[a:b])
-                packs.append((flat, offsets, b - a))
-        max_total = max(int(p[1][-1]) for p in packs)
-        max_rows = max(p[2] for p in packs)
+            flat_all, offsets_all = concat_records(data)
+        max_total = max(
+            int(offsets_all[b] - offsets_all[a]) for a, b in bounds
+        )
+        max_rows = max(b - a for a, b in bounds)
         if max_total > (1 << 30):
             raise BatchTooLarge(n_all, max_total)
         B = bucket_len(max(max_total, 4), minimum=16)
         R = bucket_len(max(max_rows, 1), minimum=8)
-        self.base.seed_caps_from_sample(data, R)
+        # capacity planner first (ISSUE 10): a schema ANY decoder in
+        # this process (or a previous one, via ROUTING_PROFILE.json)
+        # converged starts at the learned rung — zero retry compiles,
+        # no host sample probe
+        if not self.base.seed_from_plan(R):
+            self.base.seed_caps_from_sample(data, R)
 
         D = self.D
         W = B // 4
-        # ONE host-side materialization: the packed buffer is the only
-        # copy of the launch inputs; the rare shard-error path and the
-        # output meta reconstruct views from it
-        buf = np.empty((D, W + 2 * R + 1), np.uint32)
+        prog = self.base.prog
+        # persistent host arena (identity-stable across warm calls) —
+        # the packed buffer is the only host copy of the launch inputs;
+        # the rare shard-error path reconstructs views from it
+        buf = self._arena(R, B)
         ns = np.empty(D, np.int32)
         flats = []
-        for d, (flat, offsets, n) in enumerate(packs):
-            w, s, ln, fpad = pad_views(flat, offsets, n, R, B)
-            buf[d] = pack_launch_input(w, s, ln, n)
-            ns[d] = n
-            flats.append(fpad)
-
-        jax = self._jax
-        prog = self.base.prog
-        # place the shards once (ONE packed transfer); cap retries
-        # relaunch without re-sending the inputs over the interconnect
+        devs = list(self.mesh.devices.reshape(-1))
         spec = jax.sharding.NamedSharding(
             self.mesh, jax.sharding.PartitionSpec("chunks")
         )
+        # per-shard pack pipelined with per-device async h2d (ISSUE 10):
+        # shard d's transfer is dispatched BEFORE shard d+1 is packed, so
+        # the copies overlap the packing loop instead of waiting for one
+        # big [D, ...] buffer to finish; the single-device arrays then
+        # assemble into the mesh-sharded input without another copy
+        shards_d = []
+        overlap_s = 0.0
         with telemetry.phase("decode.h2d_s", bytes=buf.nbytes):
-            buf_d = jax.device_put(buf, spec)
+            for d, (a, b) in enumerate(bounds):
+                t0 = time.perf_counter()
+                n = b - a
+                base_off = int(offsets_all[a])
+                pack_launch_into(
+                    buf[d], flat_all[base_off : int(offsets_all[b])],
+                    offsets_all[a : b + 1], n, R, B,
+                )
+                ns[d] = n
+                flats.append(
+                    flat_all[base_off : int(offsets_all[b])]
+                )
+                dt_pack = time.perf_counter() - t0
+                if any(not _ready(s) for s in shards_d):
+                    # an earlier shard's async transfer was STILL in
+                    # flight when this shard's pack finished — those
+                    # host seconds genuinely ran concurrently with the
+                    # copy (checked AFTER the pack: conservative, a
+                    # transfer completing mid-pack goes uncounted)
+                    overlap_s += dt_pack
+                telemetry.observe("decode.shard_pack_s", dt_pack,
+                                  shard=d, rows=n)
+                shards_d.append(
+                    jax.device_put(buf[d : d + 1], devs[d])
+                )
+            buf_d = jax.make_array_from_single_device_arrays(
+                (D, W + 2 * R + 1), spec, shards_d
+            )
         metrics.inc("decode.h2d_bytes", buf.nbytes)
         metrics.inc("device.h2d_bytes", buf.nbytes)
+        if overlap_s:
+            metrics.inc("device.overlap_s", overlap_s)
+            metrics.inc("device.overlap_calls")
         hosts = None
+        grew = False
         for _attempt in range(24):
             item_caps, tot_caps = self.base.caps_snapshot(R)
             compact = (R, B) not in self.base._str_full
             fn, layout = self._sharded_fn(R, B, item_caps, tot_caps,
                                           compact)
+            if buf_d is None or getattr(buf_d, "is_deleted",
+                                        lambda: True)():
+                # the previous rung's donated input was consumed:
+                # re-place the shards from the host arena
+                with telemetry.phase("decode.h2d_s", bytes=buf.nbytes):
+                    buf_d = jax.device_put(buf, spec)
+                metrics.inc("decode.h2d_bytes", buf.nbytes)
+                metrics.inc("device.h2d_bytes", buf.nbytes)
             res = fn(buf_d)  # compile/launch split by the wrapper
+            buf_d = None  # donated: dead after the launch
             with telemetry.phase("decode.d2h_s"):
                 blob = np.asarray(jax.device_get(res))
             metrics.inc("decode.d2h_bytes", blob.nbytes)
@@ -218,6 +297,7 @@ class ShardedDecoder:
                 h["#red:strfit"][0] for h in hosts
             ):
                 self.base._str_full.add((R, B))
+                grew = True
                 metrics.inc("device.retries")
                 telemetry.observe(
                     "device.retry_s", 0.0,
@@ -243,6 +323,7 @@ class ShardedDecoder:
             if not self.base.grow_caps(R, item_caps, tot_caps,
                                        red_max, red_sum):
                 break
+            grew = True
             metrics.inc("device.retries")
             telemetry.observe(
                 "device.retry_s", time.perf_counter() - t0,
@@ -253,17 +334,40 @@ class ShardedDecoder:
             )
         else:
             raise MalformedAvro("array/map item capacity did not converge")
+        # teach the planner the converged rung (shared with the
+        # single-device path: its next cold call also starts warm);
+        # grew=True re-harvests a bucket whose caps climbed THIS call
+        self.base._harvest_plan(R, grew)
         device_obs.note_memory(jax)
+        wall = time.perf_counter() - time0
+        if overlap_s and wall > 0:
+            telemetry.annotate(
+                overlap_s=round(overlap_s, 6),
+                overlap_frac=round(min(overlap_s / wall, 1.0), 4),
+            )
 
+        # per-shard quarantine (ISSUE 10): EVERY failing shard runs the
+        # walk-only error pass and the indices aggregate — globally
+        # re-based — into ONE MalformedAvro, so a tolerant caller
+        # (api.py on_error=skip/null) isolates all offenders across the
+        # whole mesh in a single relaunch instead of one per shard
+        bad_indices: list = []
         for d, h in enumerate(hosts):
             if h["#red:err"][0]:
-                self._raise_shard_error(
+                t0 = time.perf_counter()
+                self._collect_shard_errors(
                     buf[d][:W],
                     buf[d][W : W + R].view(np.int32),
                     buf[d][W + R : W + 2 * R].view(np.int32),
                     ns[d],
-                    R, B, item_caps, bounds[d][0],
+                    R, B, item_caps, bounds[d][0], bad_indices,
                 )
+                telemetry.observe(
+                    "decode.shard_err_s", time.perf_counter() - t0,
+                    shard=d,
+                )
+        if bad_indices:
+            raise_aggregated_malformed(bad_indices)
 
         out = []
         for d, h in enumerate(hosts):
@@ -277,11 +381,12 @@ class ShardedDecoder:
             out.append((h, int(ns[d]), meta))
         return out
 
-    def _raise_shard_error(self, words, starts, lengths, n, R, B,
-                           item_caps, base_row: int):
-        """Re-run the (lazily compiled) walk-only error pass on the one
-        failing shard — single device, rare path — and report the GLOBAL
-        record index."""
+    def _collect_shard_errors(self, words, starts, lengths, n, R, B,
+                              item_caps, base_row: int,
+                              collect: list) -> None:
+        """Run the (lazily compiled) walk-only error pass on one failing
+        shard — single device, rare path — and append its
+        ``(GLOBAL record index, slug)`` pairs into ``collect``."""
         jax = self._jax
         err = np.asarray(
             jax.device_get(
@@ -294,21 +399,12 @@ class ShardedDecoder:
         idx = np.flatnonzero(bad)
         if idx.size == 0:  # pragma: no cover — err flag implies a bad lane
             raise MalformedAvro("device reported a malformed record")
-        indices = []
         for r in idx:
             v = int(bad[int(r)])
             b = v & -v
-            indices.append(
+            collect.append(
                 (base_row + int(r), ERR_SLUGS.get(b, f"bit_{b:#x}"))
             )
-        i = int(idx[0])
-        v = int(bad[i])
-        bit = v & -v
-        raise malformed_record(
-            base_row + i, ERR_NAMES.get(bit, f"error bit {bit:#x}"),
-            err_name=ERR_SLUGS.get(bit, f"bit_{bit:#x}"),
-            tier="device", indices=indices,
-        )
 
     def decode(self, data: Sequence[bytes], ir=None,
                arrow_schema: Optional[pa.Schema] = None

@@ -1,0 +1,209 @@
+"""Learned device capacity planner: telemetry-fed, persisted rungs.
+
+Before ISSUE 10, every cold (schema, R-bucket) pair climbed the
+capacity-retry ladder — each rung a fresh XLA compile (≈5-6 s for the
+sharded kafka pipeline on this class of box), which is exactly what the
+``device.retry_s`` spans of PR 5 made visible and what NORTH_STAR's
+30.8 s mesh figure was mostly made of. This module closes that loop:
+
+* every CONVERGED launch teaches the planner its final rung — the
+  per-region-path item caps, per-(R, region) item totals, and the B
+  buckets whose compact string descriptors overflowed
+  (:func:`learn`, called by ``DeviceDecoder`` / ``ShardedDecoder``
+  after the ladder settles);
+* every fresh decoder consults it FIRST (:func:`seed_decoder`), so a
+  schema any decoder in this process (or, via the profile, any past
+  process) has decoded starts at the learned rung: one compile, zero
+  retries, ``device.retries == 0`` on the very first call.
+
+Keys are (schema fingerprint, R bucket); values are keyed by region
+*path* strings, which are stable across processes (region ids are not
+guaranteed to be). Merging is a monotonic max — idempotent and
+order-free, so profiles from concurrent processes fold without any
+baseline subtraction.
+
+Persistence rides ``ROUTING_PROFILE.json`` (the PR 6 cost-model store):
+profile schema version 2 adds a ``"capacity"`` section next to the
+Welford ``"entries"`` (version-1 files still load — they simply carry
+no capacity knowledge). Arming follows the cost model's contract
+(``PYRUHVRO_TPU_AUTOTUNE=1``) or the dedicated
+``PYRUHVRO_TPU_CAPACITY_PERSIST=1`` knob for capacity-only workflows
+(the bench/mesh harnesses set it), so the unit suite never writes
+profile files as a side effect.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Any, Dict, List, Optional, Tuple
+
+from . import metrics
+
+__all__ = [
+    "persist_enabled",
+    "lookup",
+    "learn",
+    "seed_decoder",
+    "entries",
+    "merge_entries",
+    "snapshot",
+    "reset",
+]
+
+_lock = threading.Lock()
+# (schema fingerprint, R bucket) -> plan:
+#   {"item_caps": {path: int}, "tot_caps": {path: int},
+#    "str_full_B": set[int]}
+_plans: Dict[Tuple[str, int], Dict[str, Any]] = {}
+
+
+def persist_enabled() -> bool:
+    """Should device-capacity knowledge arm ROUTING_PROFILE persistence
+    on its own (without autotune)? ``PYRUHVRO_TPU_CAPACITY_PERSIST=1``."""
+    v = os.environ.get("PYRUHVRO_TPU_CAPACITY_PERSIST", "").strip().lower()
+    return v in ("1", "on", "true")
+
+
+def lookup(fingerprint: str, R: int) -> Optional[Dict[str, Any]]:
+    """The learned plan for (schema, R bucket), or None when cold."""
+    with _lock:
+        plan = _plans.get((fingerprint, int(R)))
+        if plan is None:
+            return None
+        return {
+            "item_caps": dict(plan["item_caps"]),
+            "tot_caps": dict(plan["tot_caps"]),
+            "str_full_B": set(plan["str_full_B"]),
+        }
+
+
+def learn(fingerprint: str, R: int, item_caps: Dict[str, int],
+          tot_caps: Dict[str, int], str_full_B=()) -> None:
+    """Fold one converged launch's final rung into the plan (monotonic
+    max per key — capacity only ever grows, mirroring ``grow_caps``)."""
+    if not fingerprint or fingerprint == "?":
+        return  # anonymous decoders have no stable cross-call identity
+    key = (fingerprint, int(R))
+    with _lock:
+        plan = _plans.get(key)
+        if plan is None:
+            plan = _plans[key] = {
+                "item_caps": {}, "tot_caps": {}, "str_full_B": set(),
+            }
+        for path, cap in (item_caps or {}).items():
+            if int(cap) > plan["item_caps"].get(path, 0):
+                plan["item_caps"][path] = int(cap)
+        for path, cap in (tot_caps or {}).items():
+            if int(cap) > plan["tot_caps"].get(path, 0):
+                plan["tot_caps"][path] = int(cap)
+        plan["str_full_B"].update(int(b) for b in str_full_B)
+
+
+def seed_decoder(decoder, R: int) -> bool:
+    """Apply the learned plan for (decoder.fingerprint, R) to a
+    ``DeviceDecoder``'s capacity memory — the warm-start half of the
+    loop. Returns True when a plan existed (counted as
+    ``device.capacity.plan_hits`` / ``.plan_misses``). Caps are merged
+    monotonically, so seeding can never shrink a rung the decoder
+    already climbed to."""
+    plan = lookup(getattr(decoder, "fingerprint", "?"), R)
+    if plan is None:
+        metrics.inc("device.capacity.plan_misses")
+        return False
+    prog = decoder.prog
+    from .pack import bucket_len
+
+    with decoder._lock:
+        for rid in range(1, len(prog.regions)):
+            path = prog.regions[rid]
+            icap = plan["item_caps"].get(path, 0)
+            if icap > decoder._item_caps[rid]:
+                decoder._item_caps[rid] = bucket_len(icap, minimum=icap)
+            tcap = plan["tot_caps"].get(path, 0)
+            if tcap > decoder._tot_cap_mem.get((R, rid), 0):
+                decoder._tot_cap_mem[(R, rid)] = tcap
+            # a planned region needs no host-sample estimate (the probe
+            # decode costs device.seed_s — the plan replaces it)
+            decoder._seed_tried.add((R, rid))
+        for b in plan["str_full_B"]:
+            decoder._str_full.add((R, int(b)))
+    metrics.inc("device.capacity.plan_hits")
+    return True
+
+
+def harvest_decoder(decoder, R: int) -> None:
+    """Teach the planner a decoder's current rung for an R bucket —
+    called after the capacity ladder converges (decode success)."""
+    prog = decoder.prog
+    if len(prog.regions) <= 1 and not decoder._str_full:
+        return
+    with decoder._lock:
+        item_caps = {
+            prog.regions[rid]: decoder._item_caps[rid]
+            for rid in range(1, len(prog.regions))
+            if decoder._item_caps[rid] > 0
+        }
+        tot_caps = {
+            prog.regions[rid]: decoder._tot_cap_mem[(R, rid)]
+            for rid in range(1, len(prog.regions))
+            if (R, rid) in decoder._tot_cap_mem
+        }
+        str_full = {b for (r, b) in decoder._str_full if r == R}
+    learn(decoder.fingerprint, R, item_caps, tot_caps, str_full)
+
+
+# ---------------------------------------------------------------------------
+# persistence document (rides ROUTING_PROFILE.json, profile version 2)
+# ---------------------------------------------------------------------------
+
+
+def entries() -> List[Dict[str, Any]]:
+    """The planner as JSON rows for the profile's ``capacity`` section."""
+    with _lock:
+        return [
+            {
+                "schema": fp,
+                "R": R,
+                "item_caps": dict(plan["item_caps"]),
+                "tot_caps": dict(plan["tot_caps"]),
+                "str_full_B": sorted(plan["str_full_B"]),
+            }
+            for (fp, R), plan in sorted(_plans.items())
+        ]
+
+
+def merge_entries(rows) -> int:
+    """Fold profile ``capacity`` rows into the live planner (max-merge);
+    malformed rows are skipped — an old/foreign profile must never fail
+    the load."""
+    merged = 0
+    for row in rows or ():
+        try:
+            learn(
+                str(row["schema"]), int(row["R"]),
+                {str(k): int(v) for k, v in (row.get("item_caps")
+                                             or {}).items()},
+                {str(k): int(v) for k, v in (row.get("tot_caps")
+                                             or {}).items()},
+                [int(b) for b in row.get("str_full_B") or ()],
+            )
+            merged += 1
+        except (KeyError, TypeError, ValueError):
+            continue
+    return merged
+
+
+def snapshot() -> Dict[str, Any]:
+    with _lock:
+        return {
+            "plans": len(_plans),
+            "schemas": len({fp for fp, _ in _plans}),
+        }
+
+
+def reset() -> None:
+    """Clear the in-memory planner (test isolation; called from
+    ``costmodel.reset()``). Does not touch the on-disk profile."""
+    with _lock:
+        _plans.clear()

@@ -65,7 +65,12 @@ __all__ = [
     "reset",
 ]
 
-PROFILE_VERSION = 1
+# version 2 (ISSUE 10): adds the learned device-capacity section
+# ("capacity": [...] rows, max-merged — see runtime/capacity.py) next
+# to the Welford arm entries. Version-1 files still LOAD (they simply
+# carry no capacity knowledge); saves always write version 2.
+PROFILE_VERSION = 2
+_READABLE_VERSIONS = (1, 2)
 
 # evidence cap per (feature, arm): past this, old counts halve before a
 # new observation lands, so the mean is an EWMA-like tracker of the
@@ -351,6 +356,11 @@ def snapshot() -> Dict[str, Any]:
         doc["device_penalties_s"] = pen  # runtime-only; never persisted
     if apen:
         doc["arm_penalties"] = apen  # runtime-only; never persisted
+    from . import capacity
+
+    cap = capacity.entries()
+    if cap:
+        doc["capacity"] = cap
     return doc
 
 
@@ -410,10 +420,10 @@ def _doc_entries(doc: Any) -> Dict[Tuple[str, str, int, str],
     speak; individual malformed entries are skipped."""
     if not isinstance(doc, dict):
         raise ValueError("routing profile must be a JSON object")
-    if doc.get("version") != PROFILE_VERSION:
+    if doc.get("version") not in _READABLE_VERSIONS:
         raise ValueError(
-            f"routing profile version {doc.get('version')!r} != "
-            f"{PROFILE_VERSION}")
+            f"routing profile version {doc.get('version')!r} not in "
+            f"{_READABLE_VERSIONS}")
     out: Dict[Tuple[str, str, int, str], List[float]] = {}
     for e in doc.get("entries") or []:
         try:
@@ -439,6 +449,11 @@ def merge_doc(doc: Any, *, loaded: bool = False) -> int:
     entries = _doc_entries(doc)
     for key, (n, mean, m2) in entries.items():
         _merge_entry(key, n, mean, m2, loaded=loaded)
+    # capacity rows (profile v2) max-merge — idempotent, so no loaded
+    # baseline is needed for them
+    from . import capacity
+
+    capacity.merge_entries(doc.get("capacity"))
     return len(entries)
 
 
@@ -498,10 +513,17 @@ def save_profile(path: Optional[str] = None) -> Optional[str]:
     except (ImportError, OSError):
         lock_fh = None
     try:
+        from . import capacity
+
         merged: Dict[Tuple[str, str, int, str], List[float]] = {}
         try:
             with open(path, encoding="utf-8") as f:
-                merged = _doc_entries(json.load(f))
+                disk_doc = json.load(f)
+            merged = _doc_entries(disk_doc)
+            # capacity is max-merged and idempotent: folding the disk
+            # rows into the live planner and exporting the union is the
+            # concurrent-writer-safe read-modify-write
+            capacity.merge_entries(disk_doc.get("capacity"))
         except (OSError, ValueError):
             pass  # first save, or a corrupt/stale file being replaced
         for key, st in own.items():
@@ -515,6 +537,9 @@ def save_profile(path: Optional[str] = None) -> Optional[str]:
             ],
             "saved_unix": round(time.time(), 3),
         }
+        cap_rows = capacity.entries()
+        if cap_rows:
+            doc["capacity"] = cap_rows
         from . import faults, fsio
 
         try:
@@ -541,7 +566,10 @@ def save_profile(path: Optional[str] = None) -> Optional[str]:
 
 
 def _atexit_save() -> None:
-    if autotune_enabled() and _stats:
+    from . import capacity
+
+    has_cap = capacity.persist_enabled() and capacity.entries()
+    if (autotune_enabled() and _stats) or has_cap:
         try:
             save_profile()
         except Exception:
@@ -575,9 +603,20 @@ def reset() -> None:
         _decides.clear()
         _penalties.clear()
         _arm_penalties.clear()
+    from . import capacity
+
+    capacity.reset()
 
 
 # warm start: a process launched with autotune on picks its profile up
-# before the first call (the load-at-import contract)
-if autotune_enabled():
+# before the first call (the load-at-import contract); capacity-persist
+# processes (ISSUE 10) need the same so a fresh process's first device
+# call starts at the learned rung
+def _capacity_persist() -> bool:
+    from . import capacity
+
+    return capacity.persist_enabled()
+
+
+if autotune_enabled() or _capacity_persist():
     arm_persistence()

@@ -300,6 +300,45 @@ class InstrumentedJit:
         _note_hit(self.fingerprint, self.kind, self.bucket)
         return self._launch(args, count_family_launch=True)
 
+    def call_async(self, *args):
+        """Dispatch WITHOUT the :func:`sync_mode` bounding block — for
+        pipelined callers (the ISSUE 10 overlap path) whose whole point
+        is keeping the launch in flight while the host packs the next
+        chunk. ``device.launch_s`` then measures dispatch only and the
+        caller's d2h carries the wait (exactly the documented
+        ``PYRUHVRO_TPU_DEVICE_SYNC=0`` shape, per call). The cold
+        (cache-miss) path still compiles and blocks as usual."""
+        if self._exe is None:
+            with self._ilock:
+                if self._exe is None:
+                    return self._compile_and_run(args)
+        metrics.inc("device.jit_cache.hits")
+        _note_hit(self.fingerprint, self.kind, self.bucket)
+        return self._launch(args, count_family_launch=True, block=False)
+
+    _DONATION_MSG = "Some donated buffers were not usable"
+
+    @classmethod
+    def _quiet_donation(cls) -> None:
+        """Idempotently install an ignore filter for XLA's "Some
+        donated buffers were not usable" warning before a compile: the
+        device pipelines donate their packed inputs as an optimization
+        (ISSUE 10), and a layout where XLA cannot alias them is
+        expected, not actionable. A plain insert (no
+        ``warnings.catch_warnings`` save/restore — that context is
+        interpreter-global and thread-unsafe, and pytest's per-test
+        filter management would discard a once-only install) keeps the
+        filter present exactly where compiles happen without ever
+        clobbering another thread's filter state."""
+        import warnings
+
+        for f in warnings.filters:
+            if f[0] == "ignore" and getattr(
+                f[1], "pattern", None
+            ) == cls._DONATION_MSG:
+                return
+        warnings.filterwarnings("ignore", message=cls._DONATION_MSG)
+
     def _compile_and_run(self, args):
         """The cache-miss path: explicit compile, then one launch. With
         a deadline active the compile runs under the
@@ -314,9 +353,11 @@ class InstrumentedJit:
         faults.fire("device_compile")
         t0 = time.perf_counter()
         exe = None
+        self._quiet_donation()
         try:
             exe = self._bounded(
-                lambda: self._jit.lower(*args).compile(), "device_compile")
+                lambda: self._jit.lower(*args).compile(),
+                "device_compile")
         except deadline.DeadlineExceeded:
             raise
         except Exception:
@@ -342,7 +383,8 @@ class InstrumentedJit:
         self._aot = True
         return self._launch(args)
 
-    def _launch(self, args, count_family_launch: bool = False):
+    def _launch(self, args, count_family_launch: bool = False,
+                block: bool = True):
         from . import deadline, faults
 
         def dispatch():
@@ -373,6 +415,7 @@ class InstrumentedJit:
             self._exe = self._jit
             self._aot = False
             t1 = time.perf_counter()
+            self._quiet_donation()
             out = self._block(self._exe(*args))
             dt = time.perf_counter() - t1
             metrics.inc("device.jit_cache.misses")
@@ -382,12 +425,14 @@ class InstrumentedJit:
                               bucket=self.bucket, mode="aot_degrade")
             note_compile(self.fingerprint, self.kind, self.bucket, dt)
             return out
-        out = self._block(out)
+        if block:
+            out = self._block(out)
         dt = time.perf_counter() - t0
         if count_family_launch and self.family:
             metrics.inc(self.family + ".launches")
         telemetry.observe("device.launch_s", dt, kind=self.kind,
-                          bucket=self.bucket)
+                          bucket=self.bucket,
+                          **({} if block else {"async": True}))
         _note_launch(self.fingerprint, self.kind, self.bucket, dt)
         return out
 

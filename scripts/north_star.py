@@ -167,6 +167,9 @@ def roundtrip100m(rows: int, chunks: int = 8) -> None:
 
 def mesh(rows: int) -> None:
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    # the capacity planner may persist its learned rungs (ISSUE 10) so
+    # later runs on this machine start warm
+    os.environ.setdefault("PYRUHVRO_TPU_CAPACITY_PERSIST", "1")
     flags = os.environ.get("XLA_FLAGS", "")
     if "xla_force_host_platform_device_count" not in flags:
         os.environ["XLA_FLAGS"] = (
@@ -174,24 +177,67 @@ def mesh(rows: int) -> None:
         ).strip()
     from pyruhvro_tpu.fallback.decoder import decode_to_record_batch
     from pyruhvro_tpu.parallel import ShardedDecoder, ShardedEncoder, chunk_mesh
+    from pyruhvro_tpu.runtime import metrics
     from pyruhvro_tpu.schema.cache import get_or_parse_schema
 
     e = get_or_parse_schema(_schema())
     m = chunk_mesh(n_devices=8)
     datums = _gen(rows)
+    sd = ShardedDecoder(e.ir, mesh=m)
     t0 = time.perf_counter()
-    batches = ShardedDecoder(e.ir, mesh=m).decode(datums, e.ir, e.arrow_schema)
-    dt = time.perf_counter() - t0
+    batches = sd.decode(datums, e.ir, e.arrow_schema)
+    cold_s = time.perf_counter() - t0
     oracle = decode_to_record_batch(datums, e.ir, e.arrow_schema)
     row = 0
     for b in batches:
         assert b.equals(oracle.slice(row, b.num_rows)), row
         row += b.num_rows
+    # steady state (ISSUE 10): the cold call above paid the one-time
+    # XLA compile (device.compile_s below); with the capacity planner
+    # there are no retry-ladder recompiles, so every later call is a
+    # pure pack→h2d→launch→d2h pipeline — the wall a long-running mesh
+    # consumer actually sees. decode_s is the warm median; the pre-PR-10
+    # 30.8 s figure was a cold call stacked with retry-rung recompiles.
+    snap0 = metrics.snapshot()
+    warm_walls = []
+    for _ in range(5):
+        t0 = time.perf_counter()
+        out = sd.decode(datums, e.ir, e.arrow_schema)
+        warm_walls.append(time.perf_counter() - t0)
+        assert sum(b.num_rows for b in out) == rows
+    warm_walls.sort()
+    warm_s = warm_walls[len(warm_walls) // 2]
+    snap = metrics.snapshot()
+
+    def delta(key):
+        return snap.get(key, 0.0) - snap0.get(key, 0.0)
+
+    pipeline_s = delta("device.pipeline_s")
+    overlap_s = delta("device.overlap_s")
+    phases = {
+        "pack_s": round(delta("decode.pack_s") / 5, 5),
+        "h2d_s": round(delta("decode.h2d_s") / 5, 5),
+        "launch_s": round(delta("device.launch_s") / 5, 5),
+        "d2h_s": round(delta("decode.d2h_s") / 5, 5),
+        # host pack/h2d seconds spent while shard transfers/launches
+        # were in flight, over the pipeline wall (> 0 = overlapping)
+        "overlap_frac": round(overlap_s / pipeline_s, 4)
+        if pipeline_s > 0 else 0.0,
+    }
+    warm_retries = int(delta("device.retries"))
     arrays = ShardedEncoder(e.ir, e.arrow_schema, mesh=m).encode(oracle)
     assert [bytes(x) for a in arrays for x in a] == [bytes(d) for d in datums]
     _record({
         "mode": "mesh", "rows": rows, "devices": 8,
-        "decode_s": round(dt, 2), "verified": "decode==oracle per shard; "
+        "decode_s": round(warm_s, 3),
+        "decode_cold_s": round(cold_s, 2),
+        "compile_s": round(snap0.get("device.compile_s", 0.0), 2),
+        "warm_reps": len(warm_walls),
+        "warm_retries": warm_retries,
+        "jit_cache_hits": int(delta("device.jit_cache.hits")),
+        "phases": phases,
+        "machine": {"cpus": os.cpu_count()},
+        "verified": "decode==oracle per shard; "
         "encode wire-exact per shard",
     })
 

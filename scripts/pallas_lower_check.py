@@ -17,6 +17,16 @@ across rounds instead of vanishing into CI logs.
 
 Run on CPU: ``PYTHONPATH= JAX_PLATFORMS=cpu python scripts/pallas_lower_check.py``
 Exit 0 = every covered shape lowers; 1 = a lowering failure (printed).
+
+``--gate`` (ISSUE 10): additionally diff the fresh stats against the
+COMMITTED ``PALLAS_LOWER_STATS.json`` and fail on any *regression* —
+a shape that lowered at the baseline and fails now, or a shape that
+was kernel-eligible and no longer is. New shapes and new failures of
+shapes the baseline already recorded as failing do not re-fail the
+gate (the absolute failure count still does, via the base exit code);
+fixing failures only improves the diff. The fresh stats are written
+next to the baseline ONLY when the gate passes, so a red run never
+overwrites the evidence it was judged against.
 """
 
 from __future__ import annotations
@@ -32,7 +42,48 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 DEFAULT_STATS = os.path.join(REPO, "PALLAS_LOWER_STATS.json")
 
 
-def main(out_path: str = DEFAULT_STATS) -> int:
+def _shape_key(row: dict) -> tuple:
+    return (row.get("schema"), row.get("BW"), row.get("cap"))
+
+
+def gate(fresh: dict, baseline_path: str = DEFAULT_STATS) -> int:
+    """Compare ``fresh`` stats against the committed baseline; return
+    the number of regressions (0 = gate passes). A missing/corrupt
+    baseline is a pass-with-warning — the first run seeds it."""
+    try:
+        with open(baseline_path, encoding="utf-8") as f:
+            base = json.load(f)
+    except (OSError, ValueError) as e:
+        print(f"[gate] no usable baseline at {baseline_path} ({e!r}); "
+              f"fresh stats become the baseline")
+        return 0
+    base_rows = {_shape_key(r): r for r in base.get("stats", [])}
+    regressions = 0
+    for row in fresh.get("stats", []):
+        b = base_rows.get(_shape_key(row))
+        if b is None:
+            continue  # newly covered shape: judged by the absolute check
+        if row.get("lowering_failed") and not b.get("lowering_failed"):
+            print(f"[gate] REGRESSION: {row['schema']} BW={row.get('BW')} "
+                  f"cap={row.get('cap')} lowered at the baseline, now "
+                  f"fails: {str(row.get('error', ''))[:200]}")
+            regressions += 1
+        elif (b.get("kernel_eligible") and not row.get("kernel_eligible")
+              and not row.get("lowering_failed")):
+            print(f"[gate] REGRESSION: {row['schema']} BW={row.get('BW')} "
+                  f"cap={row.get('cap')} lost kernel eligibility "
+                  f"({row.get('reason', 'unspecified')})")
+            regressions += 1
+    if regressions:
+        print(f"[gate] {regressions} lowering regression(s) vs "
+              f"{baseline_path}")
+    else:
+        print(f"[gate] no lowering regressions vs {baseline_path} "
+              f"({len(base_rows)} baseline shapes)")
+    return regressions
+
+
+def main(out_path: str = DEFAULT_STATS, gate_mode: bool = False) -> int:
     import jax
     import numpy as np
     # jax.export is a lazily-importable submodule on some JAX versions
@@ -104,16 +155,24 @@ def main(out_path: str = DEFAULT_STATS) -> int:
         "failures": failures,
         "stats": stats,
     }
-    try:
-        with open(out_path, "w", encoding="utf-8") as f:
-            json.dump(doc, f, indent=1)
-            f.write("\n")
-        print(f"stats -> {out_path}")
-    except OSError as e:
-        print(f"could not write {out_path}: {e!r}")
-    print(f"pallas lowering check: {failures} failures")
-    return 1 if failures else 0
+    regressions = gate(doc, out_path) if gate_mode else 0
+    # gate mode never overwrites the judged-against baseline on ANY red
+    # run — regressions OR absolute failures (a failing newly-covered
+    # shape must not become tomorrow's expected baseline)
+    if not (gate_mode and (regressions or failures)):
+        try:
+            with open(out_path, "w", encoding="utf-8") as f:
+                json.dump(doc, f, indent=1)
+                f.write("\n")
+            print(f"stats -> {out_path}")
+        except OSError as e:
+            print(f"could not write {out_path}: {e!r}")
+    print(f"pallas lowering check: {failures} failures"
+          + (f", {regressions} regression(s)" if gate_mode else ""))
+    return 1 if (failures or regressions) else 0
 
 
 if __name__ == "__main__":
-    sys.exit(main(sys.argv[1] if len(sys.argv) > 1 else DEFAULT_STATS))
+    argv = [a for a in sys.argv[1:] if a != "--gate"]
+    sys.exit(main(argv[0] if argv else DEFAULT_STATS,
+                  gate_mode="--gate" in sys.argv[1:]))

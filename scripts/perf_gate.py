@@ -480,6 +480,64 @@ def route_matrix(args) -> int:
     return 1 if failed else 0
 
 
+def device_warm_check() -> dict:
+    """ISSUE 10 acceptance gate: a WARM-schema device call must run
+    with zero capacity retries, serve every jitted entry from the cache
+    (hits > 0, misses == 0), and overlap pack/h2d with an in-flight
+    launch (``device.overlap_s`` > 0). Forces the device pipeline
+    (``backend="tpu"`` runs it on whatever XLA backend is attached —
+    CPU in CI) with a small overlap-chunk threshold so the 6k-row case
+    pipelines through several chunks."""
+    from pyruhvro_tpu import telemetry
+    from pyruhvro_tpu.api import deserialize_array
+    from pyruhvro_tpu.runtime import metrics
+    from pyruhvro_tpu.utils.datagen import (
+        KAFKA_SCHEMA_JSON,
+        kafka_style_datums,
+    )
+
+    data = kafka_style_datums(6_000, seed=11)
+    saved = os.environ.get("PYRUHVRO_TPU_OVERLAP_ROWS")
+    os.environ["PYRUHVRO_TPU_OVERLAP_ROWS"] = "512"
+    try:
+        deserialize_array(data, KAFKA_SCHEMA_JSON, backend="tpu")  # cold
+        # the overlap figure is is_ready-gated (conservative): a warm
+        # rep where every tiny launch happens to finish before the next
+        # pack does honestly reads 0 — a scheduler-timing outcome, not
+        # a regression. Retrying a few warm reps keeps the gate hard on
+        # the CONTRACT (overlap achievable) without being flaky on one
+        # unlucky scheduling (container wall swings are 2-3x here).
+        for _attempt in range(4):
+            telemetry.reset()
+            deserialize_array(data, KAFKA_SCHEMA_JSON, backend="tpu")
+            snap = metrics.snapshot()
+            if snap.get("device.overlap_s", 0.0) > 0:
+                break
+    finally:
+        if saved is None:
+            os.environ.pop("PYRUHVRO_TPU_OVERLAP_ROWS", None)
+        else:
+            os.environ["PYRUHVRO_TPU_OVERLAP_ROWS"] = saved
+    pipeline_s = snap.get("device.pipeline_s", 0.0)
+    out = {
+        "retries": int(snap.get("device.retries", 0)),
+        "jit_cache_hits": int(snap.get("device.jit_cache.hits", 0)),
+        "jit_cache_misses": int(snap.get("device.jit_cache.misses", 0)),
+        "overlap_s": round(snap.get("device.overlap_s", 0.0), 6),
+        "overlap_frac": round(
+            snap.get("device.overlap_s", 0.0) / pipeline_s, 4)
+        if pipeline_s else 0.0,
+        "arena_hits": int(snap.get("device.arena.hits", 0)),
+    }
+    out["pass"] = (
+        out["retries"] == 0
+        and out["jit_cache_hits"] >= 1
+        and out["jit_cache_misses"] == 0
+        and out["overlap_s"] > 0
+    )
+    return out
+
+
 def main(argv: Optional[list] = None) -> int:
     ap = argparse.ArgumentParser(
         prog="perf_gate.py",
@@ -510,6 +568,10 @@ def main(argv: Optional[list] = None) -> int:
     ap.add_argument("--route-tolerance", type=float,
                     default=float(os.environ.get(
                         "PYRUHVRO_TPU_ROUTE_TOLERANCE", 0.05)))
+    ap.add_argument("--no-device-check", action="store_true",
+                    help="skip the warm-device contract check (ISSUE 10:"
+                         " zero retries, all-hit jit cache, overlap "
+                         "fraction > 0 on a warm forced-device call)")
     ap.add_argument("--slo-file",
                     default=os.environ.get("PYRUHVRO_TPU_SLO_FILE"),
                     help="evaluate this SLO file over the gate run: the "
@@ -609,6 +671,22 @@ def main(argv: Optional[list] = None) -> int:
              "and the baseline")
         return 2
     failed = False
+    # warm-device contract (ISSUE 10): zero retries, all-hit jit cache,
+    # overlap fraction > 0 on the warm call — enforced, not just logged
+    dev_warm = None
+    if not args.details and not args.no_device_check:
+        try:
+            dev_warm = device_warm_check()
+        except Exception as e:  # noqa: BLE001 — named failure below
+            _log(f"[perf-gate] device warm check errored: {e!r}")
+            dev_warm = {"pass": False, "error": repr(e)}
+        _log(f"[perf-gate] device warm check: "
+             f"retries={dev_warm.get('retries')} "
+             f"cache={dev_warm.get('jit_cache_misses')} miss/"
+             f"{dev_warm.get('jit_cache_hits')} hit "
+             f"overlap_frac={dev_warm.get('overlap_frac')} -> "
+             f"{'ok' if dev_warm['pass'] else 'FAILED'}")
+        failed = failed or not dev_warm["pass"]
     # fused-decode coverage budget (ISSUE 9): when the native tier
     # served the kafka case, at least 95% of its decode calls must have
     # gone through the fused wire→Arrow pass — a creeping fallback rate
@@ -643,6 +721,7 @@ def main(argv: Optional[list] = None) -> int:
         "metric": "perf_gate",
         "pass": not failed,
         "cases": {k: round(m, 6) for k, m, _a, _r in rows},
+        **({"device_warm": dev_warm} if dev_warm is not None else {}),
     }))
     return 1 if failed else 0
 
