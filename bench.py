@@ -625,9 +625,12 @@ def main() -> None:
 
     def save_details():
         try:
+            from pyruhvro_tpu.runtime import fsio
+
             here = os.path.dirname(os.path.abspath(__file__))
-            with open(os.path.join(here, "BENCH_DETAILS.json"), "w") as f:
-                json.dump(details, f, indent=2)
+            fsio.atomic_write_json(
+                os.path.join(here, "BENCH_DETAILS.json"), details,
+                indent=2)
         except OSError as e:
             _log(f"[bench] could not write BENCH_DETAILS.json: {e!r}")
 

@@ -1,0 +1,48 @@
+"""Repo-native static-analysis plane (ISSUE 11).
+
+Three coupled passes, run as one CI gate (``scripts/analysis_gate.py``):
+
+1. :mod:`.contracts` — the cross-language opcode contract checker. The
+   fused decode path mirrors one contract in four hand-synchronized
+   places (``hostpath/program.py`` constants, the C++ enums in
+   ``runtime/native/host_vm_core.h`` / ``extract_core.h``, the
+   profiler's pseudo-op slots, and the specializer's embedded
+   ``kOps``/``kAux`` codegen); this pass makes any divergence in value,
+   arity, aux kind or op-name string a machine-checked failure instead
+   of a reviewer-memory item.
+2. :mod:`.lints` — AST invariant lints: no direct ``PYRUHVRO_TPU_*``
+   env reads outside ``runtime/knobs.py``, no metrics/lock acquisition
+   reachable from a registered signal handler, no whole-file
+   ``json.dump`` outside ``runtime/fsio.py``, and no swallowed
+   ``FaultInjected`` without a counted metric.
+3. sanitizer builds — ``runtime/native/build.py``'s ASan/UBSan flavor,
+   exercised by the gate's ``--sanitize`` mode and the CI job.
+
+Every pass reports plain :class:`Finding` rows; the gate exits non-zero
+on any finding and writes ``ANALYSIS_REPORT.json``.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+
+@dataclass
+class Finding:
+    """One analysis finding: where, which rule, and what diverged."""
+
+    rule: str      # e.g. "contract.opkind", "lint.env-read"
+    path: str      # repo-relative file
+    message: str
+    line: int = 0
+
+    def to_dict(self) -> dict:
+        return {"rule": self.rule, "path": self.path, "line": self.line,
+                "message": self.message}
+
+    def __str__(self) -> str:  # gate output: one grep-able line each
+        loc = f"{self.path}:{self.line}" if self.line else self.path
+        return f"{loc}: [{self.rule}] {self.message}"
+
+
+__all__ = ["Finding"]

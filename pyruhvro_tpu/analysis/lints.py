@@ -1,0 +1,398 @@
+"""AST invariant lints over the package source.
+
+Four rules, each enforcing an invariant PR 7/8/11 previously left to
+reviewer memory:
+
+* ``lint.env-read`` — no direct ``os.environ`` / ``os.getenv`` read of
+  a ``PYRUHVRO_*`` name outside ``runtime/knobs.py``: every knob goes
+  through the typed registry (parse-with-fallback, documented,
+  inventoried).
+* ``lint.signal-safety`` — no ``metrics.inc``/``merge``/``mark``,
+  ``faults.fire``, blocking ``.acquire()`` or ``with <lock>:`` in code
+  reachable (same-module call graph) from a function registered via
+  ``signal.signal``: the handler may have interrupted the very frame
+  that holds the non-reentrant lock. Counters bumped from signal
+  context must use ``metrics.DeferredCount``. An audited construct can
+  be waived with a ``# signal-ok: <reason>`` comment on the flagged
+  line.
+* ``lint.json-write`` — no whole-file ``json.dump`` outside
+  ``runtime/fsio.py`` (a kill mid-dump leaves a torn artifact; writers
+  go through ``fsio.atomic_write_json``). Dumping to
+  ``sys.stdout``/``sys.stderr`` is a stream, not a file, and passes.
+* ``lint.fault-seam`` — no bare ``except:`` anywhere, and every
+  handler that swallows ``FaultInjected`` (the 12 chaos seams of
+  ``runtime/faults.py``) must count a metric: a degradation that does
+  not count is a degradation nobody will ever see.
+
+The analysis is deliberately path-INsensitive (a ``metrics.inc`` behind
+``if counters:`` still flags) — that keeps it trivially sound, and the
+``# signal-ok`` waiver documents the audited exceptions in place.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Dict, Iterable, List, Optional, Sequence, Set
+
+from . import Finding
+
+__all__ = [
+    "lint_env_reads",
+    "lint_signal_safety",
+    "lint_json_writes",
+    "lint_fault_seams",
+    "run_lints",
+    "iter_py_files",
+]
+
+_KNOB_PREFIX = "PYRUHVRO_"
+_ENV_ALLOWED = ("runtime/knobs.py",)
+_JSON_ALLOWED = ("runtime/fsio.py",)
+_SIGNAL_WAIVER = "# signal-ok"
+
+# calls that may take the non-reentrant metrics/telemetry locks —
+# forbidden in signal-reachable code (DeferredCount.bump is the
+# sanctioned counter there)
+_UNSAFE_MODULE_CALLS = {
+    ("metrics", "inc"), ("metrics", "merge"), ("metrics", "mark"),
+    ("faults", "fire"),
+}
+
+
+def iter_py_files(root: str,
+                  subdirs: Sequence[str] = ("pyruhvro_tpu",)) -> List[str]:
+    out = []
+    for sub in subdirs:
+        base = os.path.join(root, sub)
+        if os.path.isfile(base):
+            out.append(base)
+            continue
+        for dirpath, dirnames, filenames in os.walk(base):
+            dirnames[:] = [d for d in dirnames
+                           if d not in ("_spec", "__pycache__")]
+            out.extend(os.path.join(dirpath, f)
+                       for f in sorted(filenames) if f.endswith(".py"))
+    return out
+
+
+def _rel(path: str, root: str) -> str:
+    try:
+        return os.path.relpath(path, root)
+    except ValueError:
+        return path
+
+
+def _parse(path: str):
+    with open(path, encoding="utf-8") as f:
+        src = f.read()
+    return ast.parse(src, filename=path), src.splitlines()
+
+
+# ---------------------------------------------------------------------------
+# lint.env-read
+# ---------------------------------------------------------------------------
+
+
+def _env_read_name(node: ast.AST) -> Optional[str]:
+    """The literal env-var name when ``node`` reads the environment:
+    ``os.environ.get(LIT, ...)``, ``os.getenv(LIT, ...)`` or
+    ``os.environ[LIT]`` (Load context)."""
+    if isinstance(node, ast.Call):
+        f = node.func
+        is_get = (isinstance(f, ast.Attribute) and f.attr == "get"
+                  and isinstance(f.value, ast.Attribute)
+                  and f.value.attr == "environ"
+                  and isinstance(f.value.value, ast.Name)
+                  and f.value.value.id == "os")
+        is_getenv = (isinstance(f, ast.Attribute) and f.attr == "getenv"
+                     and isinstance(f.value, ast.Name)
+                     and f.value.id == "os")
+        if (is_get or is_getenv) and node.args:
+            a = node.args[0]
+            if isinstance(a, ast.Constant) and isinstance(a.value, str):
+                return a.value
+    elif isinstance(node, ast.Subscript):
+        v = node.value
+        if (isinstance(node.ctx, ast.Load)
+                and isinstance(v, ast.Attribute) and v.attr == "environ"
+                and isinstance(v.value, ast.Name) and v.value.id == "os"):
+            s = node.slice
+            if isinstance(s, ast.Constant) and isinstance(s.value, str):
+                return s.value
+    elif isinstance(node, ast.Compare):
+        # '"NAME" in os.environ' membership tests read the environment
+        # too (knobs.is_set is the sanctioned form)
+        if (len(node.ops) == 1
+                and isinstance(node.ops[0], (ast.In, ast.NotIn))
+                and isinstance(node.left, ast.Constant)
+                and isinstance(node.left.value, str)
+                and len(node.comparators) == 1):
+            c = node.comparators[0]
+            if (isinstance(c, ast.Attribute) and c.attr == "environ"
+                    and isinstance(c.value, ast.Name)
+                    and c.value.id == "os"):
+                return node.left.value
+    return None
+
+
+def lint_env_reads(files: Iterable[str], root: str = ".") -> List[Finding]:
+    findings = []
+    for path in files:
+        rel = _rel(path, root)
+        if rel.replace(os.sep, "/").endswith(_ENV_ALLOWED):
+            continue
+        tree, _ = _parse(path)
+        for node in ast.walk(tree):
+            name = _env_read_name(node)
+            if name and name.startswith(_KNOB_PREFIX):
+                findings.append(Finding(
+                    "lint.env-read", rel,
+                    f"direct environment read of {name!r} — go through "
+                    "runtime/knobs.py (typed registry, counted parse "
+                    "fallback)", node.lineno))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# lint.signal-safety
+# ---------------------------------------------------------------------------
+
+
+def _collect_functions(tree: ast.AST) -> Dict[str, ast.FunctionDef]:
+    """All function defs in the module, flattened by name (nested
+    handlers included; later defs win, like runtime rebinding would)."""
+    out: Dict[str, ast.FunctionDef] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            out[node.name] = node
+    return out
+
+
+def _called_names(fn: ast.FunctionDef) -> Set[str]:
+    """Plain ``name(...)`` calls inside ``fn`` (same-module call graph
+    edges; attribute calls are cross-module and judged directly)."""
+    names = set()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call) and isinstance(node.func, ast.Name):
+            names.add(node.func.id)
+    return names
+
+
+def _handler_names(tree: ast.AST) -> Set[str]:
+    """Functions registered via ``signal.signal(<sig>, <fn>)``."""
+    out = set()
+    for node in ast.walk(tree):
+        if (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "signal"
+                and isinstance(node.func.value, ast.Name)
+                and node.func.value.id == "signal"
+                and len(node.args) >= 2
+                and isinstance(node.args[1], ast.Name)):
+            out.add(node.args[1].id)
+    return out
+
+
+def _waived(lines: List[str], lineno: int) -> bool:
+    """A ``# signal-ok: <reason>`` waiver on the flagged line or in the
+    comment block immediately above it."""
+    for ln in range(max(1, lineno - 2), min(lineno, len(lines)) + 1):
+        if _SIGNAL_WAIVER in lines[ln - 1]:
+            return True
+    return False
+
+
+def _unsafe_in_function(fn: ast.FunctionDef, rel: str,
+                        lines: List[str]) -> List[Finding]:
+    findings = []
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call):
+            f = node.func
+            if (isinstance(f, ast.Attribute)
+                    and isinstance(f.value, ast.Name)
+                    and (f.value.id, f.attr) in _UNSAFE_MODULE_CALLS):
+                if not _waived(lines, node.lineno):
+                    findings.append(Finding(
+                        "lint.signal-safety", rel,
+                        f"{f.value.id}.{f.attr}() reachable from a "
+                        "signal handler may deadlock on the "
+                        "non-reentrant lock — defer via "
+                        "metrics.DeferredCount (or waive with "
+                        "'# signal-ok: <reason>' after an audit)",
+                        node.lineno))
+            elif isinstance(f, ast.Attribute) and f.attr == "acquire":
+                blocking = None
+                for kw in node.keywords:
+                    if kw.arg == "blocking":
+                        blocking = kw.value
+                ok = blocking is not None and not (
+                    isinstance(blocking, ast.Constant)
+                    and blocking.value is True)
+                if not ok and not _waived(lines, node.lineno):
+                    findings.append(Finding(
+                        "lint.signal-safety", rel,
+                        "blocking .acquire() reachable from a signal "
+                        "handler (pass blocking=False / a caller-"
+                        "controlled flag, or waive with '# signal-ok')",
+                        node.lineno))
+        elif isinstance(node, ast.With):
+            for item in node.items:
+                ctx = item.context_expr
+                nm = (ctx.id if isinstance(ctx, ast.Name)
+                      else ctx.attr if isinstance(ctx, ast.Attribute)
+                      else "")
+                if "lock" in nm.lower() and not _waived(lines,
+                                                        node.lineno):
+                    findings.append(Finding(
+                        "lint.signal-safety", rel,
+                        f"'with {nm}:' reachable from a signal handler "
+                        "may deadlock on the non-reentrant lock",
+                        node.lineno))
+    return findings
+
+
+def lint_signal_safety(files: Iterable[str],
+                       root: str = ".") -> List[Finding]:
+    findings = []
+    for path in files:
+        rel = _rel(path, root)
+        tree, lines = _parse(path)
+        handlers = _handler_names(tree)
+        if not handlers:
+            continue
+        fns = _collect_functions(tree)
+        # BFS over the same-module call graph from each handler
+        reachable: Set[str] = set()
+        frontier = [h for h in handlers if h in fns]
+        while frontier:
+            name = frontier.pop()
+            if name in reachable:
+                continue
+            reachable.add(name)
+            frontier.extend(n for n in _called_names(fns[name])
+                            if n in fns and n not in reachable)
+        for name in sorted(reachable):
+            findings.extend(_unsafe_in_function(fns[name], rel, lines))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# lint.json-write
+# ---------------------------------------------------------------------------
+
+
+def _is_std_stream(node: ast.AST) -> bool:
+    return (isinstance(node, ast.Attribute)
+            and node.attr in ("stdout", "stderr")
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "sys")
+
+
+def lint_json_writes(files: Iterable[str], root: str = ".") -> List[Finding]:
+    findings = []
+    for path in files:
+        rel = _rel(path, root)
+        if rel.replace(os.sep, "/").endswith(_JSON_ALLOWED):
+            continue
+        tree, _ = _parse(path)
+        for node in ast.walk(tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "dump"
+                    and isinstance(node.func.value, ast.Name)
+                    and node.func.value.id == "json"):
+                continue
+            if len(node.args) >= 2 and _is_std_stream(node.args[1]):
+                continue
+            findings.append(Finding(
+                "lint.json-write", rel,
+                "whole-file json.dump outside runtime/fsio.py — a kill "
+                "mid-dump leaves a torn artifact; use "
+                "fsio.atomic_write_json", node.lineno))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# lint.fault-seam
+# ---------------------------------------------------------------------------
+
+
+def _catches_fault_injected(handler: ast.ExceptHandler) -> bool:
+    t = handler.type
+    types = t.elts if isinstance(t, ast.Tuple) else [t] if t else []
+    for e in types:
+        if isinstance(e, ast.Name) and e.id == "FaultInjected":
+            return True
+        if isinstance(e, ast.Attribute) and e.attr == "FaultInjected":
+            return True
+    return False
+
+
+def _body_counts_metric(handler: ast.ExceptHandler) -> bool:
+    """Does the handler body count its degradation? ``metrics.inc`` /
+    ``metrics.merge``, a ``DeferredCount.bump``, or a breaker
+    ``record_failure`` (the breaker exports its state to telemetry)."""
+    for node in ast.walk(handler):
+        if isinstance(node, ast.Call) and isinstance(node.func,
+                                                     ast.Attribute):
+            f = node.func
+            if (isinstance(f.value, ast.Name) and f.value.id == "metrics"
+                    and f.attr in ("inc", "merge")):
+                return True
+            if f.attr in ("bump", "record_failure"):
+                return True
+    return False
+
+
+def _body_reraises(handler: ast.ExceptHandler) -> bool:
+    return any(isinstance(n, ast.Raise) for n in ast.walk(handler))
+
+
+def lint_fault_seams(files: Iterable[str], root: str = ".") -> List[Finding]:
+    findings = []
+    for path in files:
+        rel = _rel(path, root)
+        tree, _ = _parse(path)
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            if node.type is None:
+                findings.append(Finding(
+                    "lint.fault-seam", rel,
+                    "bare 'except:' swallows everything including "
+                    "KeyboardInterrupt — name the exceptions",
+                    node.lineno))
+                continue
+            if (_catches_fault_injected(node)
+                    and not _body_reraises(node)
+                    and not _body_counts_metric(node)):
+                findings.append(Finding(
+                    "lint.fault-seam", rel,
+                    "handler swallows FaultInjected without counting a "
+                    "metric — a degradation that does not count is one "
+                    "nobody will ever see", node.lineno))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# the combined pass
+# ---------------------------------------------------------------------------
+
+
+def run_lints(root: str = ".") -> List[Finding]:
+    """All four lints over the package tree (plus scripts/ and bench.py
+    for the json-write rule — CI artifacts torn mid-write poison later
+    runs exactly like profile files do)."""
+    pkg = iter_py_files(root, ("pyruhvro_tpu",))
+    findings = []
+    findings.extend(lint_env_reads(pkg, root))
+    findings.extend(lint_signal_safety(pkg, root))
+    json_scope = list(pkg)
+    json_scope += iter_py_files(root, ("scripts",))
+    bench = os.path.join(root, "bench.py")
+    if os.path.exists(bench):
+        json_scope.append(bench)
+    findings.extend(lint_json_writes(json_scope, root))
+    findings.extend(lint_fault_seams(pkg, root))
+    return findings

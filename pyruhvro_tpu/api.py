@@ -284,9 +284,9 @@ def _decide(entry: SchemaEntry, backend: str, n_rows: int, *, op: str,
 def _native_host_codec(entry: SchemaEntry):
     """The C++ host VM codec for this schema, or None (outside the fast
     subset, no toolchain, or disabled via PYRUHVRO_TPU_NO_NATIVE)."""
-    import os
+    from .runtime import knobs
 
-    if os.environ.get("PYRUHVRO_TPU_NO_NATIVE"):
+    if knobs.get_bool("PYRUHVRO_TPU_NO_NATIVE"):
         return None
 
     def make():
@@ -326,13 +326,13 @@ def _auto_prefers_host(entry: SchemaEntry, n_rows: int):
        ``backend="tpu"`` remains the explicit override.
 
     ``PYRUHVRO_TPU_DEVICE_MIN_ROWS=<n>`` replaces both signals."""
-    import os
+    from .runtime import knobs
 
     if _native_host_codec(entry) is None:
         return None
-    env = os.environ.get("PYRUHVRO_TPU_DEVICE_MIN_ROWS")
-    if env:
-        return "device_min_rows" if n_rows < int(env) else None
+    min_rows = knobs.get_int("PYRUHVRO_TPU_DEVICE_MIN_ROWS")
+    if min_rows is not None:
+        return "device_min_rows" if n_rows < min_rows else None
     from .ops.codec import devices_cpu_only, interconnect_remote
 
     # safe: callers reach here only with a constructed device codec, so

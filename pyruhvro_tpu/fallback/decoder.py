@@ -24,7 +24,6 @@ decimal→unscaled int.
 from __future__ import annotations
 
 import decimal
-import os
 import threading
 import uuid as _uuid
 from typing import Callable, List, Sequence, Tuple
@@ -42,7 +41,7 @@ from ..schema.model import (
     Record,
     Union,
 )
-from ..schema.arrow_map import to_arrow_field, to_arrow_schema
+from ..schema.arrow_map import to_arrow_schema
 from .io import (
     MAX_ZERO_WIDTH_ITEMS,
     MalformedAvro,
@@ -52,7 +51,6 @@ from .io import (
     read_double,
     read_float,
     read_long,
-    shift_malformed,
 )
 
 __all__ = [
@@ -77,13 +75,9 @@ _DEFAULT_MAX_DEPTH = 64
 
 
 def _max_depth() -> int:
-    try:
-        return int(
-            os.environ.get("PYRUHVRO_TPU_MAX_DEPTH", "")
-            or _DEFAULT_MAX_DEPTH
-        )
-    except ValueError:
-        return _DEFAULT_MAX_DEPTH
+    from ..runtime import knobs
+
+    return knobs.get_int("PYRUHVRO_TPU_MAX_DEPTH")
 
 
 # per-thread budget of zero-width array/map items for the datum being

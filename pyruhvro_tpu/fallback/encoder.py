@@ -21,7 +21,7 @@ from __future__ import annotations
 
 import decimal
 import uuid as _uuid
-from typing import List, Sequence
+from typing import List
 
 import numpy as np
 import pyarrow as pa

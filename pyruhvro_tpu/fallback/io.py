@@ -17,7 +17,6 @@ Avro spec recap (wire format):
 
 from __future__ import annotations
 
-import os
 import struct
 
 __all__ = [
@@ -113,10 +112,9 @@ def max_datum_bytes() -> int:
     """The PYRUHVRO_TPU_MAX_DATUM_BYTES hostile-input ceiling (0 =
     unlimited, the default). A datum longer than this is rejected (or
     quarantined under a tolerant policy) before any decode work."""
-    try:
-        return int(os.environ.get("PYRUHVRO_TPU_MAX_DATUM_BYTES", "0") or 0)
-    except ValueError:
-        return 0
+    from ..runtime import knobs
+
+    return knobs.get_int("PYRUHVRO_TPU_MAX_DATUM_BYTES")
 
 
 # Zero-width array/map items (null / empty-record elements consume no

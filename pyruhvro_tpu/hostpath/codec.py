@@ -19,7 +19,7 @@ from typing import List, Sequence
 import numpy as np
 import pyarrow as pa
 
-from ..fallback.io import MalformedAvro, malformed_record
+from ..fallback.io import malformed_record
 from ..ops.varint import ERR_NAMES, ERR_SLUGS
 from ..runtime.native.build import load_host_codec
 from .program import HostProgram, lower_host
@@ -61,15 +61,9 @@ def _vm_threads(nthreads: int) -> int:
     of summing CPU time across shards), else 0 = the VM's auto pick."""
     if nthreads:
         return nthreads
-    import os
+    from ..runtime import knobs
 
-    env = os.environ.get("PYRUHVRO_TPU_VM_THREADS")
-    if env:
-        try:
-            return max(0, int(env))
-        except ValueError:
-            pass
-    return 0
+    return max(0, knobs.get_int("PYRUHVRO_TPU_VM_THREADS"))
 
 
 class NativeHostCodec:
@@ -98,21 +92,17 @@ class NativeHostCodec:
         self._mod = load_host_codec()
         if self._mod is None:
             raise RuntimeError("native host codec unavailable (no toolchain)")
-        import os
+        from ..runtime import knobs
 
         self._spec = None            # the specialized module, once built
         # the per-opcode profiler lives in the generic VM's dispatch
         # points; the specialized engines are straight-line code with
         # nothing to attribute, so profiling pins the interpreter
-        self._prof = os.environ.get("PYRUHVRO_TPU_NATIVE_PROF") == "1"
+        self._prof = knobs.get_bool("PYRUHVRO_TPU_NATIVE_PROF")
         self._spec_failed = (
-            os.environ.get("PYRUHVRO_TPU_NO_SPECIALIZE") == "1" or self._prof
+            knobs.get_bool("PYRUHVRO_TPU_NO_SPECIALIZE") or self._prof
         )
-        try:
-            self._spec_rows = int(os.environ.get(
-                "PYRUHVRO_TPU_SPECIALIZE_ROWS", self._SPECIALIZE_ROWS))
-        except ValueError:
-            self._spec_rows = self._SPECIALIZE_ROWS
+        self._spec_rows = knobs.get_int("PYRUHVRO_TPU_SPECIALIZE_ROWS")
         self._rows_seen = 0
         # Arrow-native extraction (runtime/native/extract.cpp): probed
         # lazily; PYRUHVRO_TPU_NO_NATIVE_EXTRACT=1 pins the Python
@@ -121,8 +111,8 @@ class NativeHostCodec:
         # process-wide ``native_extract`` circuit breaker decides when
         # the lane is withheld and when a half-open probe re-admits it.
         self._extract_mod = None
-        self._extract_pinned = (
-            os.environ.get("PYRUHVRO_TPU_NO_NATIVE_EXTRACT") == "1"
+        self._extract_pinned = knobs.get_bool(
+            "PYRUHVRO_TPU_NO_NATIVE_EXTRACT"
         )
         # the last Arrow schema the native extractor declined on SHAPE:
         # repeated encodes of that shape skip the doomed C++ probe (and
@@ -154,8 +144,6 @@ class NativeHostCodec:
         LargeBinaryArray): the latter ships its offsets+values buffers
         to the VM directly — zero per-datum Python objects on the
         ingest boundary."""
-        import os
-
         from ..ops.arrow_build import (
             build_fused_record_batch,
             build_record_batch,
@@ -201,8 +189,10 @@ class NativeHostCodec:
                 eng, generic = self._spec, False
             else:
                 eng, generic = self._mod, True
+            from ..runtime import knobs
+
             fused = None
-            if os.environ.get("PYRUHVRO_TPU_NO_FUSED_DECODE") != "1":
+            if not knobs.get_bool("PYRUHVRO_TPU_NO_FUSED_DECODE"):
                 fused = getattr(eng, "decode_arrow", None)
             with telemetry.phase("host.vm_s",
                                  specialized=(self._spec is not None
@@ -552,9 +542,9 @@ class NativeHostCodec:
         # against the extractor's bound instead of trusting it — a bound
         # under-estimate becomes RuntimeError, not heap corruption. Read
         # per call (it is a debug switch, toggled in tests/soaks).
-        import os
+        from ..runtime import knobs
 
-        checked = 1 if os.environ.get("PYRUHVRO_DEBUG_BOUNDS") == "1" else 0
+        checked = 1 if knobs.get_bool("PYRUHVRO_DEBUG_BOUNDS") else 0
         # fast lane: Arrow-native fused extract+encode (one GIL-released
         # C++ call straight off the Arrow buffers); None → the Python
         # extractor below serves the call (counted as extract.fallback)

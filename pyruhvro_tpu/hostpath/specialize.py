@@ -28,7 +28,7 @@ from __future__ import annotations
 
 import hashlib
 import os
-from typing import List, Optional
+from typing import List
 
 import numpy as np
 
@@ -764,6 +764,11 @@ def load_specialized(prog: HostProgram):
     """
     from ..runtime.native import build as nb
 
+    if nb._san_active():
+        # the spec cache is keyed by source content only — a sanitized
+        # build would be served to later uninstrumented runs. Sanitizer
+        # sessions pin the interpreter VM (whose .san flavor IS keyed).
+        return None
     spec_dir = os.path.join(_native_dir(), "_spec")
     try:
         core_text = ""

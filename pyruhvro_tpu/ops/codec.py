@@ -33,8 +33,9 @@ from .decode import (
 
 __all__ = ["DeviceCodec", "get_device_codec"]
 
-_PROBE_TIMEOUT_S = float(__import__("os").environ.get(
-    "PYRUHVRO_TPU_PROBE_TIMEOUT", "60"))
+from ..runtime import knobs as _knobs
+
+_PROBE_TIMEOUT_S = _knobs.get_float("PYRUHVRO_TPU_PROBE_TIMEOUT")
 _probe_result: list = []  # memoized: [devices] or [exception]
 _rtt_result: list = []    # memoized: [seconds]
 
@@ -496,9 +497,9 @@ def _pallas_mode() -> str:
     """Normalize PYRUHVRO_TPU_PALLAS to its three semantic states:
     ``"mosaic"`` ("1"/"true" — compiled kernel), ``"interpret"``, or
     ``"off"`` (anything else, incl. the conventional "0")."""
-    import os
+    from ..runtime import knobs
 
-    raw = os.environ.get("PYRUHVRO_TPU_PALLAS", "").lower()
+    raw = knobs.get_raw("PYRUHVRO_TPU_PALLAS").lower()
     if raw in ("1", "true", "mosaic"):
         return "mosaic"
     if raw == "interpret":

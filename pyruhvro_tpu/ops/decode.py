@@ -88,16 +88,11 @@ def overlap_chunks(n_rows: int) -> int:
     path). ``PYRUHVRO_TPU_OVERLAP=0`` disables; ``PYRUHVRO_TPU_OVERLAP_ROWS``
     (default 4096) is the minimum rows per chunk — chunks below it
     would pay more per-launch overhead than the overlap hides."""
-    import os
+    from ..runtime import knobs
 
-    if os.environ.get("PYRUHVRO_TPU_OVERLAP", "").strip() in ("0", "off"):
+    if not knobs.get_bool("PYRUHVRO_TPU_OVERLAP"):
         return 1
-    try:
-        min_rows = int(os.environ.get("PYRUHVRO_TPU_OVERLAP_ROWS", "")
-                       or 4096)
-    except ValueError:
-        min_rows = 4096
-    min_rows = max(1, min_rows)
+    min_rows = max(1, knobs.get_int("PYRUHVRO_TPU_OVERLAP_ROWS"))
     return max(1, min(8, n_rows // min_rows))
 
 
@@ -261,7 +256,9 @@ def _enable_persistent_cache(jax) -> None:
     _cache_enabled = True
     import os
 
-    if os.environ.get("PYRUHVRO_TPU_NO_CACHE"):
+    from ..runtime import knobs
+
+    if knobs.get_bool("PYRUHVRO_TPU_NO_CACHE"):
         return
     try:
         # CPU executables AOT-reload with machine-feature mismatches (XLA

@@ -33,7 +33,7 @@ parent item's slot (≙ the recursive ``ListDecoder``/``MapDecoder``,
 
 from __future__ import annotations
 
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Callable, Dict, List, Optional, Tuple
 
 import jax.numpy as jnp
@@ -46,11 +46,9 @@ from .varint import (
     ERR_ITEM_OVERFLOW,
     ERR_NEG_LEN,
     ERR_OVERRUN,
-    ERR_TRAILING,
     U32,
     read_bool_byte,
     read_f32,
-    read_f64_pair,
     read_f64_pair as _read_f64_pair,
     read_varint32,
     read_varint64,
@@ -65,7 +63,6 @@ from ..schema.model import (
     Map,
     Primitive,
     Record,
-    RecordField,
     Union,
 )
 

@@ -40,12 +40,11 @@ backoff seconds). State changes count ``breaker.<name>.opened`` /
 
 from __future__ import annotations
 
-import os
 import threading
 import time
 from typing import Any, Dict, Optional
 
-from . import metrics
+from . import knobs, metrics
 
 __all__ = [
     "CircuitBreaker",
@@ -63,23 +62,13 @@ _PROBE_TTL_S = 30.0
 
 
 def _env_threshold() -> Optional[int]:
-    raw = os.environ.get("PYRUHVRO_TPU_BREAKER_THRESHOLD", "").strip()
-    if not raw:
-        return None
-    try:
-        return max(1, int(raw))
-    except ValueError:
-        return None
+    v = knobs.get_int("PYRUHVRO_TPU_BREAKER_THRESHOLD")
+    return None if v is None else max(1, v)
 
 
 def _env_backoff() -> Optional[float]:
-    raw = os.environ.get("PYRUHVRO_TPU_BREAKER_BACKOFF", "").strip()
-    if not raw:
-        return None
-    try:
-        return max(0.0, float(raw))
-    except ValueError:
-        return None
+    v = knobs.get_float("PYRUHVRO_TPU_BREAKER_BACKOFF")
+    return None if v is None else max(0.0, v)
 
 
 class CircuitBreaker:

@@ -34,11 +34,10 @@ profile files as a side effect.
 
 from __future__ import annotations
 
-import os
 import threading
 from typing import Any, Dict, List, Optional, Tuple
 
-from . import metrics
+from . import knobs, metrics
 
 __all__ = [
     "persist_enabled",
@@ -61,8 +60,7 @@ _plans: Dict[Tuple[str, int], Dict[str, Any]] = {}
 def persist_enabled() -> bool:
     """Should device-capacity knowledge arm ROUTING_PROFILE persistence
     on its own (without autotune)? ``PYRUHVRO_TPU_CAPACITY_PERSIST=1``."""
-    v = os.environ.get("PYRUHVRO_TPU_CAPACITY_PERSIST", "").strip().lower()
-    return v in ("1", "on", "true")
+    return knobs.get_bool("PYRUHVRO_TPU_CAPACITY_PERSIST")
 
 
 def lookup(fingerprint: str, R: int) -> Optional[Dict[str, Any]]:

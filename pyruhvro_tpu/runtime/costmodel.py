@@ -37,7 +37,7 @@ import threading
 import time
 from typing import Any, Dict, List, Optional, Tuple
 
-from . import metrics
+from . import knobs, metrics
 
 __all__ = [
     "PROFILE_VERSION",
@@ -110,8 +110,7 @@ def autotune_enabled() -> bool:
     """``PYRUHVRO_TPU_AUTOTUNE=1`` — the router predicts/acts from this
     model instead of the static env-knob gates (read per call so tests
     and the perf-gate matrix can flip it in-process)."""
-    v = os.environ.get("PYRUHVRO_TPU_AUTOTUNE", "").strip().lower()
-    return v in ("1", "on", "true")
+    return knobs.get_bool("PYRUHVRO_TPU_AUTOTUNE")
 
 
 def explore_rate() -> float:
@@ -119,12 +118,7 @@ def explore_rate() -> float:
     0.05): roughly this fraction of autotuned calls try the
     least-observed candidate arm instead of the predicted-best one.
     0 disables exploration (pure exploitation of the warm profile)."""
-    raw = os.environ.get("PYRUHVRO_TPU_EXPLORE", "")
-    try:
-        r = float(raw) if raw else 0.05
-    except ValueError:
-        r = 0.05
-    return min(1.0, max(0.0, r))
+    return min(1.0, max(0.0, knobs.get_float("PYRUHVRO_TPU_EXPLORE")))
 
 
 def profile_path() -> str:
@@ -132,8 +126,11 @@ def profile_path() -> str:
     ``ROUTING_PROFILE.json`` in the working directory — next to
     ``PERF_BASELINE.json`` in this repo's CI). Empty string disables
     persistence."""
-    return os.environ.get("PYRUHVRO_TPU_ROUTING_PROFILE",
-                          "ROUTING_PROFILE.json")
+    # set-but-empty disables persistence, so the raw value (not the
+    # empty-means-default get_str view) is the contract here
+    if knobs.is_set("PYRUHVRO_TPU_ROUTING_PROFILE"):
+        return knobs.get_raw("PYRUHVRO_TPU_ROUTING_PROFILE")
+    return knobs.get("PYRUHVRO_TPU_ROUTING_PROFILE").default
 
 
 # ---------------------------------------------------------------------------

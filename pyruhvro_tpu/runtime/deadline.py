@@ -37,10 +37,11 @@ blocked?". ``timeout_s=None`` (default) defers to the env knob; no knob
 
 from __future__ import annotations
 
-import os
 import threading
 import time
 from typing import Any, Callable, Optional
+
+from . import knobs
 
 __all__ = [
     "DeadlineExceeded",
@@ -98,14 +99,8 @@ def _rebuild(args, op, budget_s, elapsed_s, index, site, wedged=False):
 def default_timeout_s() -> Optional[float]:
     """The process-wide default budget (``PYRUHVRO_TPU_DEADLINE_S``;
     unset/empty/malformed = no default = unbounded)."""
-    raw = os.environ.get("PYRUHVRO_TPU_DEADLINE_S", "").strip()
-    if not raw:
-        return None
-    try:
-        v = float(raw)
-    except ValueError:
-        return None
-    return v if v >= 0 else None
+    v = knobs.get_float("PYRUHVRO_TPU_DEADLINE_S")
+    return v if (v is not None and v >= 0) else None
 
 
 class _Deadline:

@@ -44,13 +44,12 @@ the wait, exactly the pre-PR-5 shape.
 
 from __future__ import annotations
 
-import os
 import threading
 import time
 from collections import deque
 from typing import Any, Dict, Optional, Tuple
 
-from . import metrics, telemetry
+from . import knobs, metrics, telemetry
 
 __all__ = [
     "InstrumentedJit",
@@ -70,19 +69,12 @@ _memory: Dict[str, Dict[str, Any]] = {}
 _compile_log: Dict[str, deque] = {}
 
 
-def _env_float(name: str, default: float) -> float:
-    try:
-        return float(os.environ.get(name, "") or default)
-    except ValueError:
-        return default
-
-
 def churn_window_s() -> float:
-    return max(0.001, _env_float("PYRUHVRO_TPU_RECOMPILE_WINDOW", 60.0))
+    return max(0.001, knobs.get_float("PYRUHVRO_TPU_RECOMPILE_WINDOW"))
 
 
 def churn_threshold() -> int:
-    return max(1, int(_env_float("PYRUHVRO_TPU_RECOMPILE_STORM", 8)))
+    return max(1, knobs.get_int("PYRUHVRO_TPU_RECOMPILE_STORM"))
 
 
 def sync_mode() -> bool:
@@ -99,15 +91,15 @@ def sync_mode() -> bool:
     from . import sampling
 
     deep = sampling.deep_active()
-    v = os.environ.get("PYRUHVRO_TPU_DEVICE_SYNC", "").strip().lower()
-    if v in ("1", "on", "true"):
+    v = knobs.get_tristate("PYRUHVRO_TPU_DEVICE_SYNC")
+    if v is True:
         if deep:
             # the sync IS this tier's deep path; a sampled call must
             # register it even when the env already forces syncing, or
             # the sampler would treat every device sample as skipped
             sampling.note_deep_ran()
         return True
-    if v in ("0", "off", "false"):
+    if v is False:
         return False
     if deep:
         sampling.note_deep_ran()

@@ -33,11 +33,10 @@ the baseline now) and the detector re-arms. Fed from
 
 from __future__ import annotations
 
-import os
 import threading
 from typing import Any, Dict, List, Tuple
 
-from . import metrics
+from . import knobs, metrics
 
 __all__ = ["observe", "snapshot_drift", "reset"]
 
@@ -52,19 +51,11 @@ _state: Dict[Tuple[str, str, int, str], List[float]] = {}
 
 
 def _ratio() -> float:
-    try:
-        v = float(os.environ.get("PYRUHVRO_TPU_DRIFT_RATIO", "") or 1.5)
-    except ValueError:
-        v = 1.5
-    return max(1.01, v)
+    return max(1.01, knobs.get_float("PYRUHVRO_TPU_DRIFT_RATIO"))
 
 
 def _sustain() -> int:
-    try:
-        v = int(os.environ.get("PYRUHVRO_TPU_DRIFT_SUSTAIN", "") or 5)
-    except ValueError:
-        v = 5
-    return max(1, v)
+    return max(1, knobs.get_int("PYRUHVRO_TPU_DRIFT_SUSTAIN"))
 
 
 def observe(schema: str, op: str, band: int, arm: str,

@@ -41,7 +41,7 @@ import threading
 import time
 from typing import Dict, Optional, Tuple
 
-from . import metrics
+from . import knobs, metrics
 
 __all__ = [
     "FaultInjected",
@@ -109,11 +109,7 @@ def hang_seconds() -> float:
     """Sleep length of the ``hang`` kind (``PYRUHVRO_TPU_FAULT_HANG_S``,
     default 2.0 s). Bounded by design: a chaos hang exists to trip
     deadlines and watchdogs, not to wedge the test harness."""
-    try:
-        return max(0.0, float(
-            os.environ.get("PYRUHVRO_TPU_FAULT_HANG_S", "") or 2.0))
-    except ValueError:
-        return 2.0
+    return max(0.0, knobs.get_float("PYRUHVRO_TPU_FAULT_HANG_S"))
 
 
 _lock = threading.Lock()
@@ -153,7 +149,7 @@ def _plan() -> Dict[str, Tuple[str, float]]:
     """The active injection plan (re-parsed when the env var changes, so
     tests and the chaos harness can flip specs in-process)."""
     global _plan_memo
-    raw = os.environ.get("PYRUHVRO_TPU_FAULTS", "")
+    raw = knobs.get_raw("PYRUHVRO_TPU_FAULTS")
     memo = _plan_memo
     if memo is not None and memo[0] == raw:
         return memo[1]

@@ -1,0 +1,409 @@
+"""Typed central registry of every ``PYRUHVRO_*`` environment knob.
+
+Before this module existed, ~40 knobs were read at ~120 sites across
+five packages, each with its own ad-hoc ``int(os.environ.get(...) or
+default)`` parse — and nothing but grep stood between a renamed knob
+and a silently-dead configuration surface. This registry is the single
+source of truth: every knob's name, type, default and documentation
+live HERE, every read goes through a typed accessor, and the analysis
+gate (``pyruhvro_tpu/analysis/lints.py``) fails CI on any direct
+``os.environ`` read of a ``PYRUHVRO_TPU_*`` name anywhere else in the
+package. The README knob table is generated from this registry
+(``python -m pyruhvro_tpu.telemetry knobs --markdown``), so the docs
+cannot drift either.
+
+Semantics shared by every accessor:
+
+* values are read from the environment **at call time** (never cached),
+  preserving the repo-wide contract that tests and the perf-gate matrix
+  flip knobs in-process;
+* an unset/empty variable yields the registered default at zero parse
+  cost;
+* a malformed value NEVER raises: it falls back to the default and
+  counts ``knob.parse_error`` (plus ``knob.parse_error.<NAME>``) — a
+  typo'd knob must degrade loudly in telemetry, not take the process
+  down at import.
+
+Adding a knob: add one :func:`_reg` line below (keep the section
+ordering), read it through the typed accessor, and re-run
+``scripts/analysis_gate.py --fix-knob-table`` to refresh the README.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+from typing import Any, Dict, Optional, Tuple
+
+from . import metrics
+
+__all__ = [
+    "Knob",
+    "registry",
+    "get",
+    "get_raw",
+    "get_str",
+    "get_int",
+    "get_float",
+    "get_bool",
+    "get_tristate",
+    "get_enum",
+    "is_set",
+    "inventory",
+    "render_markdown_table",
+]
+
+# normalized boolean vocabularies (get_bool / get_tristate)
+_TRUE = ("1", "true", "yes", "on")
+_FALSE = ("0", "false", "no", "off")
+
+
+@dataclass(frozen=True)
+class Knob:
+    """One registered environment knob."""
+
+    name: str           # full env var name (PYRUHVRO_TPU_*)
+    type: str           # int | float | bool | tristate | str | enum
+    default: Any        # typed default; None = "unset means absent/off"
+    doc: str            # one-line operator documentation
+    choices: Tuple[str, ...] = ()  # enum: accepted (normalized) values
+
+
+_REGISTRY: Dict[str, Knob] = {}
+
+
+def _reg(name: str, type_: str, default: Any, doc: str,
+         choices: Tuple[str, ...] = ()) -> None:
+    assert name not in _REGISTRY, f"duplicate knob {name}"
+    _REGISTRY[name] = Knob(name, type_, default, doc, choices)
+
+
+# ---- routing / backend selection ------------------------------------------
+_reg("PYRUHVRO_TPU_NO_NATIVE", "bool", False,
+     "Disable the C++ host VM entirely; the pure-Python fallback serves "
+     "host-tier calls.")
+_reg("PYRUHVRO_TPU_DEVICE_MIN_ROWS", "int", None,
+     "Replace the auto gate's placement signals: device serves batches "
+     ">= n rows, host below.")
+_reg("PYRUHVRO_TPU_POOL", "enum", "thread",
+     "Chunk fan-out pool for host-tier chunked calls.",
+     choices=("thread", "process"))
+_reg("PYRUHVRO_TPU_AUTOTUNE", "bool", False,
+     "Adaptive routing: tier and pool choice comes from the learned "
+     "cost model instead of the static env gates.")
+_reg("PYRUHVRO_TPU_EXPLORE", "float", 0.05,
+     "Autotune exploration rate in [0, 1]: fraction of calls that try "
+     "the least-observed arm.")
+_reg("PYRUHVRO_TPU_ROUTING_PROFILE", "str", "ROUTING_PROFILE.json",
+     "Where warm routing knowledge persists (empty string disables "
+     "persistence).")
+_reg("PYRUHVRO_TPU_LEDGER_N", "int", 256,
+     "Routing decision ledger ring size (entries kept for "
+     "route-report/what-if).")
+_reg("PYRUHVRO_TPU_PALLAS", "enum", "off",
+     "Route eligible schemas through the Pallas kernel: 1/true/mosaic "
+     "= compiled kernel, interpret = interpreter mode, anything else "
+     "= off.", choices=("off", "mosaic", "interpret"))
+_reg("PYRUHVRO_TPU_PROBE_TIMEOUT", "float", 60.0,
+     "Backend-init watchdog in seconds for the one-time device/RTT "
+     "probe.")
+
+# ---- host VM / specializer ------------------------------------------------
+_reg("PYRUHVRO_TPU_VM_THREADS", "int", 0,
+     "Pin the decode VM's shard-thread count (0 = auto).")
+_reg("PYRUHVRO_TPU_SPECIALIZE_ROWS", "int", 20_000,
+     "Hot-schema C++ compile threshold in cumulative rows (0 = "
+     "specialize immediately).")
+_reg("PYRUHVRO_TPU_NO_SPECIALIZE", "bool", False,
+     "Pin the interpreter VM (never build schema-specialized codecs).")
+_reg("PYRUHVRO_TPU_NO_NATIVE_EXTRACT", "bool", False,
+     "Pin serialize's host tier to the Python Arrow extractor (the "
+     "differential oracle).")
+_reg("PYRUHVRO_TPU_NO_FUSED_DECODE", "bool", False,
+     "Pin decode's Arrow assembly to the Python oracle instead of the "
+     "fused native decode_arrow pass.")
+_reg("PYRUHVRO_DEBUG_BOUNDS", "bool", False,
+     "Native encoder verifies every write against the extractor's "
+     "bound instead of trusting it.")
+_reg("PYRUHVRO_TPU_NATIVE_PROF", "bool", False,
+     "Build/load the per-opcode-profiled native modules (vm.op.* "
+     "self-time telemetry).")
+_reg("PYRUHVRO_TPU_NATIVE_SAN", "bool", False,
+     "Build/load the ASan+UBSan-instrumented native modules (separate "
+     "cached flavor; run python under the sanitizer runtime preload — "
+     "see scripts/analysis_gate.py --sanitize).")
+
+# ---- device tier ----------------------------------------------------------
+_reg("PYRUHVRO_TPU_OVERLAP", "bool", True,
+     "Double-buffered h2d/compute overlap on device decodes (0/off "
+     "disables).")
+_reg("PYRUHVRO_TPU_OVERLAP_ROWS", "int", 4096,
+     "Minimum rows per overlap sub-batch.")
+_reg("PYRUHVRO_TPU_NO_CACHE", "bool", False,
+     "Disable the persistent XLA compilation cache hookup.")
+_reg("PYRUHVRO_TPU_DEVICE_SYNC", "tristate", None,
+     "Force (1) / disable (0) block_until_ready-bounded launches; "
+     "unset = auto.")
+_reg("PYRUHVRO_TPU_RECOMPILE_WINDOW", "float", 60.0,
+     "Per-schema compile-churn window in seconds.")
+_reg("PYRUHVRO_TPU_RECOMPILE_STORM", "int", 8,
+     "Compiles within the window that count as a recompile storm.")
+
+# ---- hostile-input guards -------------------------------------------------
+_reg("PYRUHVRO_TPU_MAX_DATUM_BYTES", "int", 0,
+     "Hostile-input ceiling: any datum longer than this is rejected "
+     "before decode work (0 = unlimited).")
+_reg("PYRUHVRO_TPU_MAX_DEPTH", "int", 64,
+     "Fallback walker nesting-depth cap (enforced at schema compile "
+     "time).")
+
+# ---- fault domains --------------------------------------------------------
+_reg("PYRUHVRO_TPU_FAULTS", "str", "",
+     "Deterministic fault-injection spec: "
+     "site:kind:rate[:seed][,site2:...] (see runtime/faults.py).")
+_reg("PYRUHVRO_TPU_FAULT_HANG_S", "float", 2.0,
+     "Sleep length of the 'hang' fault kind in seconds.")
+_reg("PYRUHVRO_TPU_DEADLINE_S", "float", None,
+     "Process-wide default per-call deadline budget in seconds "
+     "(unset = unbounded).")
+_reg("PYRUHVRO_TPU_BREAKER_THRESHOLD", "int", None,
+     "Failures to open a circuit breaker (overrides every breaker's "
+     "default).")
+_reg("PYRUHVRO_TPU_BREAKER_BACKOFF", "float", None,
+     "Circuit-breaker base backoff in seconds (overrides the default "
+     "schedule).")
+_reg("PYRUHVRO_TPU_QUARANTINE_STORM", "int", 100,
+     "Quarantined rows per call that count as a storm (flight dump + "
+     "health bit).")
+
+# ---- observability --------------------------------------------------------
+_reg("PYRUHVRO_TPU_NO_TELEMETRY", "bool", False,
+     "Start with spans + histograms off (counters stay on).")
+_reg("PYRUHVRO_TPU_TRACE", "str", "",
+     "Opt-in JSON-lines span trace: a file path or 'stderr'.")
+_reg("PYRUHVRO_TPU_FLIGHT_DIR", "str", "",
+     "Enable flight-recorder auto-dumps into this directory (also arms "
+     "the SIGUSR1 dump hook).")
+_reg("PYRUHVRO_TPU_FLIGHT_N", "int", 64,
+     "Flight-recorder ring size in root spans.")
+_reg("PYRUHVRO_TPU_FLIGHT_MAX_FILES", "int", 32,
+     "Flight-recorder auto-dump retention (0 = unlimited).")
+_reg("PYRUHVRO_TPU_OBS_PORT", "int", None,
+     "Start the in-process observability server on this port at import "
+     "(0 = any free port).")
+_reg("PYRUHVRO_TPU_OBS_HOST", "str", "127.0.0.1",
+     "Bind host for the observability server.")
+_reg("PYRUHVRO_TPU_HEALTH_WINDOW", "float", 60.0,
+     "How long a storm/drift event keeps /healthz unhealthy, in "
+     "seconds.")
+_reg("PYRUHVRO_TPU_SLO_FILE", "str", "",
+     "JSON file of latency/error-rate objectives fed to the burn-rate "
+     "engine.")
+_reg("PYRUHVRO_TPU_SAMPLE_BUDGET", "float", 0.01,
+     "Adaptive deep-profiling overhead budget as a wall-time fraction "
+     "(<= 0 disables the sampler).")
+_reg("PYRUHVRO_TPU_DRIFT_RATIO", "float", 1.5,
+     "Fast/slow EWMA ratio that counts as latency drift.")
+_reg("PYRUHVRO_TPU_DRIFT_SUSTAIN", "int", 5,
+     "Consecutive drifted observations before a detection fires.")
+_reg("PYRUHVRO_TPU_CAPACITY_PERSIST", "bool", False,
+     "Persist learned device-capacity plans into ROUTING_PROFILE even "
+     "without autotune.")
+
+
+# ---------------------------------------------------------------------------
+# accessors
+# ---------------------------------------------------------------------------
+
+
+def registry() -> Dict[str, Knob]:
+    """A copy of the full registry (name -> Knob), insertion-ordered."""
+    return dict(_REGISTRY)
+
+
+def get(name: str) -> Knob:
+    """The registered :class:`Knob` for ``name`` (KeyError when the
+    name was never registered — reading unregistered knobs is exactly
+    the drift this module exists to prevent)."""
+    return _REGISTRY[name]
+
+
+# Parse errors are counted through DeferredCounts because knob getters
+# are reachable from signal handlers (the SIGUSR1 flight dump reads
+# FLIGHT_MAX_FILES, SIGUSR2 reads SAMPLE_BUDGET) where metrics.inc
+# could deadlock on the non-reentrant lock — the same invariant the
+# signal-safety lint enforces, which cannot see this cross-module
+# chain. bump() is increment-only (signal-safe); pending deltas flush
+# on the next metrics.snapshot() (see metrics._flush_hooks).
+_parse_error_counts: Dict[str, metrics.DeferredCount] = {}
+
+
+def _parse_error(name: str) -> None:
+    for key in ("knob.parse_error", "knob.parse_error." + name):
+        dc = _parse_error_counts.get(key)
+        if dc is None:
+            dc = _parse_error_counts.setdefault(
+                key, metrics.DeferredCount(key))
+        dc.bump()
+
+
+def _flush_parse_errors() -> None:
+    """Publish pending parse-error counts (normal thread context only);
+    registered as a metrics snapshot flush hook."""
+    for dc in list(_parse_error_counts.values()):
+        dc.flush()
+
+
+metrics.register_flush_hook(_flush_parse_errors)
+
+
+def get_raw(name: str) -> str:
+    """The raw environment value of a REGISTERED knob ("" when unset).
+    The sanctioned escape hatch for knobs whose site needs custom
+    normalization (e.g. PYRUHVRO_TPU_PALLAS alias folding) — the name
+    must still be registered, so docs and inventory stay complete."""
+    assert name in _REGISTRY, f"unregistered knob {name}"
+    return os.environ.get(name, "")
+
+
+def get_str(name: str) -> str:
+    """String knob: the raw value, or the registered default when
+    unset/empty."""
+    raw = os.environ.get(name, "")
+    return raw if raw else _REGISTRY[name].default
+
+
+def _parse_number(name: str, cast):
+    k = _REGISTRY[name]
+    raw = os.environ.get(name, "").strip()
+    if not raw:
+        return k.default
+    try:
+        return cast(raw)
+    except ValueError:
+        _parse_error(name)
+        return k.default
+
+
+def _parse_boolish(name: str):
+    k = _REGISTRY[name]
+    raw = os.environ.get(name, "").strip().lower()
+    if not raw:
+        return k.default
+    if raw in _TRUE:
+        return True
+    if raw in _FALSE:
+        return False
+    _parse_error(name)
+    return k.default
+
+
+def get_int(name: str) -> Optional[int]:
+    """Integer knob: parsed value, or the registered default when
+    unset/empty/malformed (malformed counts ``knob.parse_error``)."""
+    return _parse_number(name, int)
+
+
+def get_float(name: str) -> Optional[float]:
+    """Float knob: parsed value, or the registered default when
+    unset/empty/malformed (malformed counts ``knob.parse_error``)."""
+    return _parse_number(name, float)
+
+
+def get_bool(name: str) -> bool:
+    """Boolean knob: 1/true/yes/on -> True, 0/false/no/off -> False
+    (case-insensitive), unset/empty -> default, anything else counts
+    ``knob.parse_error`` and yields the default."""
+    return _parse_boolish(name)
+
+
+def get_tristate(name: str) -> Optional[bool]:
+    """Tri-state knob: True / False / None-for-auto, same vocabulary as
+    :func:`get_bool` (the registered default is normally None = auto)."""
+    return _parse_boolish(name)
+
+
+def is_set(name: str) -> bool:
+    """Is the knob present in the environment at all (even as an empty
+    string)? The sanctioned membership test for knobs whose set-but-
+    empty state is semantically distinct from unset (e.g.
+    PYRUHVRO_TPU_ROUTING_PROFILE: empty disables persistence)."""
+    assert name in _REGISTRY, f"unregistered knob {name}"
+    return name in os.environ
+
+
+def get_enum(name: str) -> str:
+    """Enum knob: the normalized (lowercased) value when it is one of
+    the registered choices, else ``knob.parse_error`` + default."""
+    k = _REGISTRY[name]
+    raw = os.environ.get(name, "").strip().lower()
+    if not raw:
+        return k.default
+    if raw in k.choices:
+        return raw
+    _parse_error(name)
+    return k.default
+
+
+# ---------------------------------------------------------------------------
+# rendering (telemetry CLI, README generation, ANALYSIS_REPORT)
+# ---------------------------------------------------------------------------
+
+
+def inventory() -> list:
+    """The registry as a JSON-able list (ANALYSIS_REPORT.json's
+    ``knobs`` section), plus each knob's CURRENT raw setting when set."""
+    out = []
+    for k in _REGISTRY.values():
+        ent: Dict[str, Any] = {
+            "name": k.name,
+            "type": k.type,
+            "default": k.default,
+            "doc": k.doc,
+        }
+        if k.choices:
+            ent["choices"] = list(k.choices)
+        raw = os.environ.get(k.name)
+        if raw is not None:
+            ent["set"] = raw
+        out.append(ent)
+    return out
+
+
+def _default_label(k: Knob) -> str:
+    if k.default is None:
+        return "unset"
+    if k.type in ("bool", "tristate"):
+        return "1" if k.default else "0"
+    return str(k.default)
+
+
+def render_markdown_table() -> str:
+    """The README knob table, generated from the registry (kept in sync
+    by the analysis gate's README drift check)."""
+    lines = [
+        "| knob | type | default | what it does |",
+        "|---|---|---|---|",
+    ]
+    for k in _REGISTRY.values():
+        doc = k.doc
+        if k.choices:
+            doc += " Choices: " + "/".join(k.choices) + "."
+        lines.append(
+            f"| `{k.name}` | {k.type} | `{_default_label(k)}` | {doc} |"
+        )
+    return "\n".join(lines) + "\n"
+
+
+def render_text_table() -> str:
+    """Plain-text rendering for ``python -m pyruhvro_tpu.telemetry
+    knobs``: one block per knob, current setting included when set."""
+    out = []
+    for k in _REGISTRY.values():
+        head = f"{k.name}  [{k.type}, default {_default_label(k)}]"
+        raw = os.environ.get(k.name)
+        if raw is not None:
+            head += f"  (set: {raw!r})"
+        out.append(head)
+        out.append("    " + k.doc)
+    return "\n".join(out) + "\n"

@@ -21,7 +21,7 @@ from collections import defaultdict
 from typing import Dict
 
 __all__ = ["inc", "merge", "snapshot", "reset", "timer", "record_deltas",
-           "mark", "mark_age", "DeferredCount"]
+           "mark", "mark_age", "DeferredCount", "register_flush_hook"]
 
 _lock = threading.Lock()
 _counters: Dict[str, float] = defaultdict(float)
@@ -134,7 +134,20 @@ class DeferredCount:
             self._reported = 0
 
 
+# modules holding DeferredCounts that signal context may bump register
+# a flush callback here; snapshot() runs them (lock NOT held) so
+# deferred deltas are never invisible to a reader. Hooks must be
+# idempotent and cheap.
+_flush_hooks: list = []
+
+
+def register_flush_hook(fn) -> None:
+    _flush_hooks.append(fn)
+
+
 def snapshot() -> Dict[str, float]:
+    for fn in _flush_hooks:
+        fn()
     with _lock:
         return dict(_counters)
 

@@ -33,7 +33,31 @@ def _prof_active() -> bool:
     of the host codec + extractor (a separate cached .so compiled with
     -DPYRUHVRO_NATIVE_PROF; the default build carries zero profiling
     code). Read per load so tests can toggle it."""
-    return os.environ.get("PYRUHVRO_TPU_NATIVE_PROF") == "1"
+    from .. import knobs
+
+    return knobs.get_bool("PYRUHVRO_TPU_NATIVE_PROF")
+
+
+# the ASan+UBSan build flavor (ISSUE 11): a separate cached .so per
+# module exactly like the .prof variant. The instrumented binaries need
+# the sanitizer runtimes loaded BEFORE CPython (LD_PRELOAD) — use
+# ``scripts/analysis_gate.py --sanitize``, which execs the suites under
+# the right preload + ASAN_OPTIONS, rather than setting the knob by hand.
+_SAN_FLAGS = (
+    "-fsanitize=address,undefined",
+    "-fno-sanitize-recover=undefined",
+    "-fno-omit-frame-pointer",
+    "-g",
+)
+
+
+def _san_active() -> bool:
+    """PYRUHVRO_TPU_NATIVE_SAN=1 selects the sanitizer-instrumented
+    build of every JIT-compiled module. Read per load so the gate's
+    subprocess env controls it."""
+    from .. import knobs
+
+    return knobs.get_bool("PYRUHVRO_TPU_NATIVE_SAN")
 
 
 def _cpu_tag() -> str:
@@ -108,12 +132,15 @@ def _compile(so: str, src: str, extra_flags=()) -> None:
             os.unlink(tmp)
 
 
-def _load(mod_name: str, src_file: str, prof: bool = False):
+def _load(mod_name: str, src_file: str, prof: bool = False,
+          san: bool = None):
     """Compile-if-stale and import one extension module (memoized;
     None is memoized too so a broken toolchain is probed once).
     ``prof=True`` builds/loads the profiled variant to a distinct cached
-    file (``<mod>.prof<EXT_SUFFIX>``); both variants export the same
-    module name, so either satisfies the PyInit lookup."""
+    file (``<mod>.prof<EXT_SUFFIX>``); ``san=True`` (default: the
+    PYRUHVRO_TPU_NATIVE_SAN knob) the ASan+UBSan-instrumented one
+    (``<mod>.san<EXT_SUFFIX>``, composable with prof). Every variant
+    exports the same module name, so any satisfies the PyInit lookup."""
     from .. import faults
 
     try:
@@ -122,16 +149,24 @@ def _load(mod_name: str, src_file: str, prof: bool = False):
         # build must come back once the fault spec clears)
         faults.fire("native_build")
     except faults.FaultInjected:
+        from .. import metrics
+
+        metrics.inc("native.build_degraded")
         return None
-    key = mod_name + ("@prof" if prof else "")
+    if san is None:
+        san = _san_active()
+    key = mod_name + ("@san" if san else "") + ("@prof" if prof else "")
     if key in _modules:
         return _modules[key]
     with _lock:
         if key in _modules:
             return _modules[key]
-        so = _so_path(mod_name + (".prof" if prof else ""))
+        so = _so_path(mod_name + (".san" if san else "")
+                      + (".prof" if prof else ""))
         src = os.path.join(_HERE, src_file)
         flags = ("-DPYRUHVRO_NATIVE_PROF=1",) if prof else ()
+        if san:
+            flags += _SAN_FLAGS
         try:
             if _needs_build(so, src):
                 try:
@@ -167,9 +202,11 @@ def loaded_host_codec_with(symbol: str):
     (assembler, extractor). Never triggers a JIT build, so hot paths
     can call it freely; a stale .so without the symbol makes the guard
     site and the dispatch site fall back together. Prefers the profiled
-    variant when PYRUHVRO_TPU_NATIVE_PROF selects it."""
-    keys = ("_pyruhvro_hostcodec@prof", "_pyruhvro_hostcodec") \
-        if _prof_active() else ("_pyruhvro_hostcodec",)
+    variant when PYRUHVRO_TPU_NATIVE_PROF selects it (and the sanitizer
+    flavor when PYRUHVRO_TPU_NATIVE_SAN does)."""
+    san = "@san" if _san_active() else ""
+    base = "_pyruhvro_hostcodec" + san
+    keys = (base + "@prof", base) if _prof_active() else (base,)
     for key in keys:
         mod = _modules.get(key)
         if mod is not None and hasattr(mod, symbol):

@@ -36,7 +36,7 @@ import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, Optional, Tuple
 
-from . import metrics
+from . import knobs, metrics
 
 __all__ = [
     "ObsServer",
@@ -57,12 +57,7 @@ _server: Optional["ObsServer"] = None
 
 
 def _health_window_s() -> float:
-    try:
-        v = float(os.environ.get("PYRUHVRO_TPU_HEALTH_WINDOW", "")
-                  or _DEFAULT_HEALTH_WINDOW_S)
-    except ValueError:
-        v = _DEFAULT_HEALTH_WINDOW_S
-    return max(0.0, v)
+    return max(0.0, knobs.get_float("PYRUHVRO_TPU_HEALTH_WINDOW"))
 
 
 def _native_state() -> str:
@@ -322,7 +317,7 @@ def start_from_env() -> Optional[ObsServer]:
     import-time hook in :mod:`.telemetry`). A malformed value or an
     unbindable port is counted and logged, never raised — observability
     must not take the service down."""
-    raw = os.environ.get("PYRUHVRO_TPU_OBS_PORT", "").strip()
+    raw = knobs.get_raw("PYRUHVRO_TPU_OBS_PORT").strip()
     if not raw:
         return None
     try:
@@ -342,8 +337,7 @@ def start_from_env() -> Optional[ObsServer]:
         return None
     try:
         srv = start(port=port,
-                    host=os.environ.get("PYRUHVRO_TPU_OBS_HOST",
-                                        "127.0.0.1"))
+                    host=knobs.get_str("PYRUHVRO_TPU_OBS_HOST"))
     except OSError:
         metrics.inc("obs.bind_error")
         return None

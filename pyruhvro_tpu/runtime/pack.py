@@ -13,7 +13,7 @@ cache (keyed by ``(schema, R, L)``) stays small.
 
 from __future__ import annotations
 
-from typing import List, Sequence, Tuple
+from typing import Sequence, Tuple
 
 import numpy as np
 

@@ -42,7 +42,7 @@ import time
 from concurrent.futures import ProcessPoolExecutor, ThreadPoolExecutor
 from typing import Callable, List, Optional, Sequence
 
-from . import breaker, deadline, metrics, telemetry
+from . import breaker, deadline, knobs, metrics, telemetry
 
 __all__ = ["get_pool", "map_chunks", "get_process_pool", "map_chunks_proc",
            "pool_mode", "process_available", "fanout_stats"]
@@ -54,8 +54,7 @@ _lock = threading.Lock()
 
 def pool_mode() -> str:
     """``thread`` (default) or ``process`` (PYRUHVRO_TPU_POOL)."""
-    mode = os.environ.get("PYRUHVRO_TPU_POOL", "thread")
-    return mode if mode in ("thread", "process") else "thread"
+    return knobs.get_enum("PYRUHVRO_TPU_POOL")
 
 
 def process_available() -> bool:

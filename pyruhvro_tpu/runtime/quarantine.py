@@ -24,11 +24,10 @@ the pool boundary.
 
 from __future__ import annotations
 
-import os
 import threading
 from typing import List, NamedTuple, Optional
 
-from . import metrics
+from . import knobs, metrics
 
 __all__ = [
     "QuarantinedRecord",
@@ -112,12 +111,7 @@ def last() -> List[QuarantinedRecord]:
 
 
 def _storm_threshold() -> int:
-    try:
-        return int(
-            os.environ.get("PYRUHVRO_TPU_QUARANTINE_STORM", "") or 100
-        )
-    except ValueError:
-        return 100
+    return knobs.get_int("PYRUHVRO_TPU_QUARANTINE_STORM")
 
 
 def publish(entries: List[QuarantinedRecord], policy: str,

@@ -33,13 +33,13 @@ where a different arm would have won.
 
 from __future__ import annotations
 
-import os
 import threading
 import time
 from collections import deque
 from typing import Any, Dict, List, Optional, Tuple
 
-from . import breaker, costmodel, deadline, drift, metrics, sampling, telemetry
+from . import (breaker, costmodel, deadline, drift, knobs, metrics,
+               sampling, telemetry)
 
 __all__ = [
     "RouteDecision",
@@ -53,14 +53,7 @@ __all__ = [
 ]
 
 
-def _env_int(name: str, default: int) -> int:
-    try:
-        return int(os.environ.get(name, "") or default)
-    except ValueError:
-        return default
-
-
-_LEDGER_N = max(1, _env_int("PYRUHVRO_TPU_LEDGER_N", 256))
+_LEDGER_N = max(1, knobs.get_int("PYRUHVRO_TPU_LEDGER_N"))
 
 _lock = threading.Lock()
 _ledger: deque = deque(maxlen=_LEDGER_N)

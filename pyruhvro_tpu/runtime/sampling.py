@@ -35,12 +35,11 @@ incident-time debugging without a restart.
 
 from __future__ import annotations
 
-import os
 import threading
 import time
 from typing import Any, Dict, Optional, Tuple
 
-from . import metrics
+from . import knobs, metrics
 
 __all__ = [
     "enabled",
@@ -98,11 +97,7 @@ def budget() -> float:
     """Target fraction of total wall time the deep path may cost
     (``PYRUHVRO_TPU_SAMPLE_BUDGET``, default 0.01 = 1%). <= 0 disables
     the sampler."""
-    raw = os.environ.get("PYRUHVRO_TPU_SAMPLE_BUDGET", "")
-    try:
-        return float(raw) if raw else 0.01
-    except ValueError:
-        return 0.01
+    return knobs.get_float("PYRUHVRO_TPU_SAMPLE_BUDGET")
 
 
 def enabled() -> bool:
@@ -138,10 +133,9 @@ def toggle(counters: bool = True) -> bool:
     global _forced
     new = not enabled()
     _forced = new
+    _toggles.bump()  # signal-safe: increment only
     if counters:
-        metrics.inc("sampling.toggled")
-    else:
-        _toggles.bump()
+        _toggles.flush()
     return new
 
 

@@ -50,7 +50,7 @@ import time
 from collections import deque
 from typing import Any, Dict, List, Optional
 
-from . import metrics
+from . import knobs, metrics
 
 __all__ = [
     "active",
@@ -215,7 +215,7 @@ class _Objective:
 
 
 def _path() -> str:
-    return os.environ.get("PYRUHVRO_TPU_SLO_FILE", "")
+    return knobs.get_raw("PYRUHVRO_TPU_SLO_FILE")
 
 
 def _ensure_config() -> None:

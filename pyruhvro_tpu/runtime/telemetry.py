@@ -59,7 +59,7 @@ from bisect import bisect_left
 from collections import deque
 from typing import Any, Dict, List, Optional
 
-from . import metrics, slo
+from . import knobs, metrics, slo
 
 __all__ = [
     "SNAPSHOT_SCHEMA_VERSION",
@@ -102,24 +102,17 @@ _MAX_SPANS = 64  # root spans retained for snapshot(); older ones are counted
 SNAPSHOT_SCHEMA_VERSION = 2
 
 
-def _env_int(name: str, default: int) -> int:
-    try:
-        return int(os.environ.get(name, "") or default)
-    except ValueError:
-        return default
-
-
 # flight recorder: compact records of the last N root spans, kept even
 # after the span itself ages out of the snapshot ring, dumpable as a
 # post-mortem artifact (see the "flight recorder" section below)
-_FLIGHT_N = max(1, _env_int("PYRUHVRO_TPU_FLIGHT_N", 64))
+_FLIGHT_N = max(1, knobs.get_int("PYRUHVRO_TPU_FLIGHT_N"))
 
 _lock = threading.Lock()
 _hists: Dict[str, "_Hist"] = {}
 _spans: deque = deque(maxlen=_MAX_SPANS)
 _flight: deque = deque(maxlen=_FLIGHT_N)
 _roots_seen = 0
-_enabled = os.environ.get("PYRUHVRO_TPU_NO_TELEMETRY") != "1"
+_enabled = not knobs.get_bool("PYRUHVRO_TPU_NO_TELEMETRY")
 _tls = threading.local()
 
 
@@ -482,7 +475,10 @@ def flight_dump(path: Optional[str] = None, *, blocking: bool = True):
     }
     if path is None:
         return doc
-    faults.fire("flight_dump")
+    if blocking:
+        # signal-ok: gated to the non-signal path — fire() takes the
+        # metrics/faults locks, which the interrupted frame may hold
+        faults.fire("flight_dump")
     return fsio.atomic_write_json(path, doc)
 
 
@@ -490,13 +486,14 @@ def _flight_max_files() -> int:
     """Auto-dump retention cap (``PYRUHVRO_TPU_FLIGHT_MAX_FILES``,
     default 32, 0 = unlimited): sustained storms must not grow the dump
     directory without bound."""
-    return max(0, _env_int("PYRUHVRO_TPU_FLIGHT_MAX_FILES", 32))
+    return max(0, knobs.get_int("PYRUHVRO_TPU_FLIGHT_MAX_FILES"))
 
 
-# rotation deletions observed from SIGNAL context defer their count
-# (metrics._lock is not reentrant and the handler may have interrupted
-# a frame inside it); flushed on the next snapshot/rotation
+# rotation deletions / dump errors observed from SIGNAL context defer
+# their count (metrics._lock is not reentrant and the handler may have
+# interrupted a frame inside it); flushed on the next normal-path pass
 _flight_dropped = metrics.DeferredCount("flight.dump_dropped")
+_flight_dump_errors = metrics.DeferredCount("flight.dump_error")
 
 
 def _rotate_flight_dir(d: str, keep: int, counters: bool = True) -> int:
@@ -539,10 +536,10 @@ def _rotate_flight_dir(d: str, keep: int, counters: bool = True) -> int:
             dropped += 1
         except OSError:
             continue
-    if not counters:
-        _flight_dropped.bump(dropped)  # signal side: increment only
-    elif dropped:
-        metrics.inc("flight.dump_dropped", float(dropped))
+    if dropped:
+        _flight_dropped.bump(dropped)  # signal-safe: increment only
+        if counters:
+            _flight_dropped.flush()
     return dropped
 
 
@@ -554,7 +551,7 @@ def _flight_autodump(tag: str, blocking: bool = True) -> Optional[str]:
     allowed to fail the call it observes. ``blocking=False`` from
     signal context (see _flight_records)."""
     global _flight_seq, _flight_last_auto
-    d = os.environ.get("PYRUHVRO_TPU_FLIGHT_DIR")
+    d = knobs.get_str("PYRUHVRO_TPU_FLIGHT_DIR")
     if not d:
         return None
     now = time.monotonic()
@@ -569,8 +566,11 @@ def _flight_autodump(tag: str, blocking: bool = True) -> Optional[str]:
         out = flight_dump(path, blocking=blocking)
     except (OSError, ValueError, FaultInjected):
         # a failed dump (incl. injected chaos) must never fail the call
-        # it observes
-        metrics.inc("flight.dump_error")
+        # it observes; the count defers (signal-safe) and flushes
+        # immediately on the normal path
+        _flight_dump_errors.bump()
+        if blocking:
+            _flight_dump_errors.flush()
         return None
     _rotate_flight_dir(d, _flight_max_files(), counters=blocking)
     return out
@@ -608,9 +608,9 @@ def install_flight_signal() -> bool:
 # any code change; everyone else pays nothing (no handler installed).
 # SIGUSR2 (toggle deep sampling live) rides the same opt-in, plus the
 # obs-server one — both are incident-time controls.
-if (os.environ.get("PYRUHVRO_TPU_FLIGHT_DIR")
-        or os.environ.get("PYRUHVRO_TPU_OBS_PORT")):
-    if os.environ.get("PYRUHVRO_TPU_FLIGHT_DIR"):
+if (knobs.get_str("PYRUHVRO_TPU_FLIGHT_DIR")
+        or knobs.get_raw("PYRUHVRO_TPU_OBS_PORT")):
+    if knobs.get_str("PYRUHVRO_TPU_FLIGHT_DIR"):
         install_flight_signal()
     from . import sampling as _sampling
 
@@ -619,7 +619,7 @@ if (os.environ.get("PYRUHVRO_TPU_FLIGHT_DIR")
 # the live observability plane (runtime/obs_server.py): opt-in via
 # PYRUHVRO_TPU_OBS_PORT, started once at import so a service gets
 # /metrics + /healthz without any code change
-if os.environ.get("PYRUHVRO_TPU_OBS_PORT"):
+if knobs.get_raw("PYRUHVRO_TPU_OBS_PORT"):
     from . import obs_server as _obs_server
 
     _obs_server.start_from_env()
@@ -989,7 +989,7 @@ def _trace_sink():
     """Resolve PYRUHVRO_TPU_TRACE to a writable handle (memoized per
     path; re-resolved when the env var changes, so tests can redirect)."""
     global _trace_memo
-    path = os.environ.get("PYRUHVRO_TPU_TRACE", "")
+    path = knobs.get_raw("PYRUHVRO_TPU_TRACE")
     if not path:
         return None
     memo = _trace_memo
@@ -1359,7 +1359,20 @@ def main(argv: Optional[List[str]] = None) -> int:
     p_serve.add_argument("--port", type=int, default=0,
                          help="bind port (default 0 = any free port)")
     p_serve.add_argument("--host", default="127.0.0.1")
+    p_knobs = sub.add_parser(
+        "knobs", help="render the typed PYRUHVRO_* knob registry "
+                      "(runtime/knobs.py) — the source the README "
+                      "table is generated from")
+    p_knobs.add_argument("--markdown", action="store_true",
+                         help="emit the README markdown table instead "
+                              "of the plain-text listing")
     args = ap.parse_args(argv)
+
+    if args.cmd == "knobs":
+        # registry rendering needs no snapshot file
+        sys.stdout.write(knobs.render_markdown_table() if args.markdown
+                         else knobs.render_text_table())
+        return 0
 
     def _usage_error(msg: str) -> int:
         # a missing/malformed snapshot is an operator mistake, not a
@@ -1437,8 +1450,9 @@ def main(argv: Optional[List[str]] = None) -> int:
                 "'histograms' keys)")
         trace = perfetto_trace(data)
         if args.out:
-            with open(args.out, "w", encoding="utf-8") as f:
-                json.dump(trace, f, indent=1, default=str)
+            from . import fsio
+
+            fsio.atomic_write_json(args.out, trace, indent=1)
             n = sum(1 for e in trace["traceEvents"] if e["ph"] == "X")
             print(f"wrote {n} span event(s) -> {args.out} "
                   "(load in ui.perfetto.dev)", file=sys.stderr)

@@ -10,6 +10,7 @@ Usage::
     python -m pyruhvro_tpu.telemetry what-if snapshot.json
     python -m pyruhvro_tpu.telemetry slo-report snapshot.json
     python -m pyruhvro_tpu.telemetry serve snapshot.json --port 9464
+    python -m pyruhvro_tpu.telemetry knobs [--markdown]
 
 (``scripts/metrics_report.py`` is the tier-1-safe wrapper over the same
 entry point; ``perfetto`` output loads in ui.perfetto.dev /

@@ -20,6 +20,8 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+from pyruhvro_tpu.runtime import fsio  # noqa: E402  (after sys.path)
+
 
 def _best(fn, reps=3):
     fn()
@@ -61,8 +63,7 @@ def main() -> None:
                   f"({t_fa/t_us:.1f}x)", file=sys.stderr)
     path = os.path.join(os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))), "FASTAVRO_SWEEP.json")
-    with open(path, "w") as f:
-        json.dump(out, f, indent=2)
+    fsio.atomic_write_json(path, out, indent=2)
     print(json.dumps({"cells": len(out["cells"]),
                       "min_speedup": min(c["speedup"] for c in out["cells"]),
                       "max_speedup": max(c["speedup"] for c in out["cells"])}))

@@ -34,6 +34,8 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+from pyruhvro_tpu.runtime import fsio  # noqa: E402  (after sys.path)
+
 BASELINE_DECODE = 10_000 / 1.17e-3
 BASELINE_ENCODE = 10_000 / 1.40e-3
 
@@ -60,8 +62,7 @@ def _record(result: dict) -> None:
     except Exception:
         existing = {}
     existing[result["mode"]] = result
-    with open(path, "w") as f:
-        json.dump(existing, f, indent=2)
+    fsio.atomic_write_json(path, existing, indent=2)
     print(json.dumps(result), flush=True)
 
 

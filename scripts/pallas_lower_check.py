@@ -38,6 +38,8 @@ import time
 
 sys.path.insert(0, ".")
 
+from pyruhvro_tpu.runtime import fsio  # noqa: E402  (after sys.path)
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 DEFAULT_STATS = os.path.join(REPO, "PALLAS_LOWER_STATS.json")
 
@@ -161,9 +163,7 @@ def main(out_path: str = DEFAULT_STATS, gate_mode: bool = False) -> int:
     # shape must not become tomorrow's expected baseline)
     if not (gate_mode and (regressions or failures)):
         try:
-            with open(out_path, "w", encoding="utf-8") as f:
-                json.dump(doc, f, indent=1)
-                f.write("\n")
+            fsio.atomic_write_json(out_path, doc, indent=1)
             print(f"stats -> {out_path}")
         except OSError as e:
             print(f"could not write {out_path}: {e!r}")

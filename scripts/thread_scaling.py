@@ -19,6 +19,8 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+from pyruhvro_tpu.runtime import fsio  # noqa: E402  (after sys.path)
+
 
 def main() -> None:
     from pyruhvro_tpu.hostpath.codec import NativeHostCodec
@@ -47,8 +49,7 @@ def main() -> None:
     out["engine"] = "specialized" if codec._spec is not None else "interpreter"
     path = os.path.join(os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))), "THREAD_SCALING.json")
-    with open(path, "w") as f:
-        json.dump(out, f, indent=2)
+    fsio.atomic_write_json(path, out, indent=2)
     print(json.dumps(out))
 
 

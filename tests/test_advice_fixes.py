@@ -5,7 +5,6 @@ import decimal
 import pyarrow as pa
 import pytest
 
-from pyruhvro_tpu.api import deserialize_array, serialize_record_batch
 from pyruhvro_tpu.fallback.decoder import decode_to_record_batch, MalformedAvro
 from pyruhvro_tpu.fallback.encoder import encode_record_batch
 from pyruhvro_tpu.fallback.io import write_long, write_bytes

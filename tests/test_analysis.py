@@ -1,0 +1,443 @@
+"""The analysis plane (ISSUE 11): contract checker, knob registry,
+AST lints, gate wiring.
+
+Drift detection is tested against FIXTURE COPIES of the real files with
+one seeded divergence each — the checker must catch the seed and stay
+quiet on the pristine tree. Lints are tested both on minimal bad
+snippets (must fire) and on the real package tree (must stay quiet).
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from pyruhvro_tpu.analysis import contracts, lints
+from pyruhvro_tpu.runtime import knobs, metrics
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_CONTRACT_FILES = (
+    "pyruhvro_tpu/hostpath/program.py",
+    "pyruhvro_tpu/hostpath/codec.py",
+    "pyruhvro_tpu/hostpath/specialize.py",
+    "pyruhvro_tpu/ops/varint.py",
+    "pyruhvro_tpu/runtime/native/host_vm_core.h",
+    "pyruhvro_tpu/runtime/native/extract_core.h",
+    "pyruhvro_tpu/runtime/native/arrow_decode_core.h",
+)
+
+
+class _FixtureTree:
+    """A minimal copy of the contract surfaces, mutable per test."""
+
+    def __init__(self, base):
+        self.base = base
+        for rel in _CONTRACT_FILES:
+            dst = base / rel
+            dst.parent.mkdir(parents=True, exist_ok=True)
+            shutil.copy(os.path.join(REPO, rel), dst)
+
+    def __str__(self):
+        return str(self.base)
+
+    def mutate(self, rel, old, new):
+        p = self.base / rel
+        s = p.read_text()
+        assert s.count(old) >= 1, f"seed anchor {old!r} missing in {rel}"
+        p.write_text(s.replace(old, new, 1))
+
+
+@pytest.fixture()
+def fixture_tree(tmp_path):
+    return _FixtureTree(tmp_path)
+
+
+# ---------------------------------------------------------------------------
+# contract checker
+# ---------------------------------------------------------------------------
+
+
+def test_contracts_clean_on_real_tree():
+    assert contracts.check_contracts(REPO) == []
+
+
+def test_contracts_catch_enum_value_drift(fixture_tree):
+    fixture_tree.mutate("pyruhvro_tpu/runtime/native/host_vm_core.h",
+                        "OP_MAP = 12,", "OP_MAP = 99,")
+    fs = contracts.check_contracts(str(fixture_tree), generative=False)
+    assert any(f.rule == "contract.opkind" and "OP_MAP" in f.message
+               for f in fs), fs
+
+
+def test_contracts_catch_missing_enum_member(fixture_tree):
+    fixture_tree.mutate("pyruhvro_tpu/runtime/native/host_vm_core.h",
+                        "OP_DEC_FIXED = 15,", "")
+    fs = contracts.check_contracts(str(fixture_tree), generative=False)
+    assert any(f.rule == "contract.opkind" and "OP_DEC_FIXED" in f.message
+               for f in fs), fs
+
+
+def test_contracts_catch_coltype_drift(fixture_tree):
+    fixture_tree.mutate("pyruhvro_tpu/runtime/native/host_vm_core.h",
+                        "COL_OFFS = 6,", "COL_OFFS = 7,")
+    fs = contracts.check_contracts(str(fixture_tree), generative=False)
+    assert any(f.rule == "contract.coltype" for f in fs), fs
+
+
+def test_contracts_catch_err_bit_drift(fixture_tree):
+    fixture_tree.mutate("pyruhvro_tpu/runtime/native/host_vm_core.h",
+                        "ERR_DEC_RANGE = 1 << 8,", "ERR_DEC_RANGE = 1 << 9,")
+    fs = contracts.check_contracts(str(fixture_tree), generative=False)
+    assert any(f.rule == "contract.err" and "ERR_DEC_RANGE" in f.message
+               for f in fs), fs
+
+
+def test_contracts_catch_slot_name_drift(fixture_tree):
+    fixture_tree.mutate("pyruhvro_tpu/runtime/native/host_vm_core.h",
+                        '"dec_bytes"', '"decbytes"')
+    fs = contracts.check_contracts(str(fixture_tree), generative=False)
+    assert any(f.rule == "contract.prof-slots" and "dec_bytes" in f.message
+               for f in fs), fs
+
+
+def test_contracts_catch_pseudo_slot_drift(fixture_tree):
+    fixture_tree.mutate("pyruhvro_tpu/runtime/native/host_vm_core.h",
+                        "P_COLLECT = 16,", "P_COLLECT = 17,")
+    fs = contracts.check_contracts(str(fixture_tree), generative=False)
+    assert any(f.rule == "contract.prof-slots" for f in fs), fs
+
+
+def test_contracts_catch_drain_prefix_drift(fixture_tree):
+    # the Python drain consumer stops mentioning a native domain prefix
+    fixture_tree.mutate("pyruhvro_tpu/hostpath/codec.py",
+                        "vm.encop.", "vm.encopX.")
+    fs = contracts.check_contracts(str(fixture_tree), generative=False)
+    assert any(f.rule == "contract.drain-keys"
+               and "vm.encop." in f.message for f in fs), fs
+
+
+def test_contracts_catch_aux_tag_drift(fixture_tree):
+    # extract_core.h stops parsing a tag program.py emits
+    fixture_tree.mutate("pyruhvro_tpu/runtime/native/extract_core.h",
+                        'strcmp(t, "duration")', 'strcmp(t, "durationX")')
+    fs = contracts.check_contracts(str(fixture_tree), generative=False)
+    assert any(f.rule == "contract.aux-tags" and "duration" in f.message
+               for f in fs), fs
+
+
+def test_contracts_catch_aux_arity_drift(monkeypatch):
+    """A specializer that emits the wrong decimal precision (aux ARITY
+    payload) in its embedded kAux table is caught by the generative
+    diff."""
+    from pyruhvro_tpu.hostpath import specialize
+
+    real = specialize._static_tables
+
+    def bad_tables(prog):
+        return real(prog).replace("{AUX_DECIMAL, nullptr, nullptr, 10}",
+                                  "{AUX_DECIMAL, nullptr, nullptr, 11}")
+
+    monkeypatch.setattr(specialize, "_static_tables", bad_tables)
+    fs = contracts._check_specializer_tables()
+    assert any(f.rule == "contract.spec-tables" and "precision" in f.message
+               for f in fs), fs
+
+
+def test_contracts_catch_kops_table_drift(monkeypatch):
+    from pyruhvro_tpu.hostpath import specialize
+
+    real = specialize._static_tables
+
+    def bad_tables(prog):
+        out = real(prog)
+        first = out.index("},")
+        # corrupt the first kOps row's subtree size
+        row_start = out.index("{", out.index("kOps"))
+        row = out[row_start:first + 1]
+        return out.replace(row, row.replace(", 0}", ", 7}"), 1)
+
+    monkeypatch.setattr(specialize, "_static_tables", bad_tables)
+    fs = contracts._check_specializer_tables()
+    assert any(f.rule == "contract.spec-tables" for f in fs), fs
+
+
+# ---------------------------------------------------------------------------
+# knob registry
+# ---------------------------------------------------------------------------
+
+
+def test_knob_parse_fallback_counts(monkeypatch):
+    monkeypatch.setenv("PYRUHVRO_TPU_SPECIALIZE_ROWS", "banana")
+    before = metrics.snapshot().get("knob.parse_error", 0.0)
+    assert knobs.get_int("PYRUHVRO_TPU_SPECIALIZE_ROWS") == 20_000
+    snap = metrics.snapshot()
+    assert snap.get("knob.parse_error", 0.0) == before + 1
+    assert snap.get(
+        "knob.parse_error.PYRUHVRO_TPU_SPECIALIZE_ROWS", 0.0) == 1
+
+
+def test_knob_bool_vocabulary(monkeypatch):
+    for raw, want in (("1", True), ("true", True), ("ON", True),
+                      ("0", False), ("off", False), ("", False)):
+        monkeypatch.setenv("PYRUHVRO_TPU_NO_NATIVE", raw)
+        assert knobs.get_bool("PYRUHVRO_TPU_NO_NATIVE") is want, raw
+    monkeypatch.setenv("PYRUHVRO_TPU_NO_NATIVE", "maybe")
+    assert knobs.get_bool("PYRUHVRO_TPU_NO_NATIVE") is False  # default
+    assert metrics.snapshot().get(
+        "knob.parse_error.PYRUHVRO_TPU_NO_NATIVE", 0.0) == 1
+
+
+def test_knob_tristate_and_enum(monkeypatch):
+    monkeypatch.delenv("PYRUHVRO_TPU_DEVICE_SYNC", raising=False)
+    assert knobs.get_tristate("PYRUHVRO_TPU_DEVICE_SYNC") is None
+    monkeypatch.setenv("PYRUHVRO_TPU_DEVICE_SYNC", "1")
+    assert knobs.get_tristate("PYRUHVRO_TPU_DEVICE_SYNC") is True
+    monkeypatch.setenv("PYRUHVRO_TPU_POOL", "process")
+    assert knobs.get_enum("PYRUHVRO_TPU_POOL") == "process"
+    monkeypatch.setenv("PYRUHVRO_TPU_POOL", "carrier-pigeon")
+    assert knobs.get_enum("PYRUHVRO_TPU_POOL") == "thread"
+
+
+def test_every_registered_knob_renders():
+    inv = knobs.inventory()
+    assert len(inv) >= 40
+    table = knobs.render_markdown_table()
+    text = knobs.render_text_table()
+    for ent in inv:
+        assert ent["name"] in table and ent["name"] in text
+
+
+def test_knobs_read_at_call_time(monkeypatch):
+    monkeypatch.setenv("PYRUHVRO_TPU_QUARANTINE_STORM", "7")
+    from pyruhvro_tpu.runtime import quarantine
+
+    assert quarantine._storm_threshold() == 7
+    monkeypatch.setenv("PYRUHVRO_TPU_QUARANTINE_STORM", "9")
+    assert quarantine._storm_threshold() == 9
+
+
+# ---------------------------------------------------------------------------
+# AST lints: fire on a minimal bad snippet, quiet on the real tree
+# ---------------------------------------------------------------------------
+
+
+def _snippet(tmp_path, name, src):
+    p = tmp_path / name
+    p.write_text(textwrap.dedent(src))
+    return str(p)
+
+
+def test_lint_env_read_fires(tmp_path):
+    bad = _snippet(tmp_path, "bad_env.py", """
+        import os
+        x = os.environ.get("PYRUHVRO_TPU_SOMETHING", "1")
+        y = os.getenv("PYRUHVRO_TPU_OTHER")
+        z = os.environ["PYRUHVRO_TPU_THIRD"]
+        w = "PYRUHVRO_TPU_FOURTH" in os.environ
+    """)
+    fs = lints.lint_env_reads([bad], str(tmp_path))
+    assert len(fs) == 4 and all(f.rule == "lint.env-read" for f in fs)
+
+
+def test_lint_env_read_allows_registry_and_nonliteral(tmp_path):
+    ok = _snippet(tmp_path, "ok_env.py", """
+        import os
+        name = "PYRUHVRO_TPU_DYNAMIC"
+        v = os.environ.get(name)          # non-literal: propagation code
+        os.environ["PYRUHVRO_TPU_SET"] = "1"   # writes are fine
+        w = os.environ.get("JAX_PLATFORMS")    # foreign prefix is fine
+    """)
+    assert lints.lint_env_reads([ok], str(tmp_path)) == []
+
+
+def test_lint_signal_safety_fires(tmp_path):
+    bad = _snippet(tmp_path, "bad_signal.py", """
+        import signal
+        from . import metrics
+
+        def helper():
+            metrics.inc("boom")
+
+        def handler(signum, frame):
+            helper()
+
+        signal.signal(signal.SIGUSR1, handler)
+    """)
+    fs = lints.lint_signal_safety([bad], str(tmp_path))
+    assert any("metrics.inc" in f.message for f in fs), fs
+
+
+def test_lint_signal_safety_lock_and_acquire(tmp_path):
+    bad = _snippet(tmp_path, "bad_lock.py", """
+        import signal
+        import threading
+        _lock = threading.Lock()
+
+        def handler(signum, frame):
+            _lock.acquire()
+            with _lock:
+                pass
+            ok = _lock.acquire(blocking=False)  # this one is fine
+
+        signal.signal(signal.SIGUSR2, handler)
+    """)
+    fs = lints.lint_signal_safety([bad], str(tmp_path))
+    assert len([f for f in fs if "acquire" in f.message]) == 1, fs
+    assert any("with _lock" in f.message for f in fs), fs
+
+
+def test_lint_signal_safety_waiver(tmp_path):
+    ok = _snippet(tmp_path, "waived.py", """
+        import signal
+        from . import metrics
+
+        def handler(signum, frame):
+            # signal-ok: audited — gated to the non-signal path
+            metrics.inc("boom")
+
+        signal.signal(signal.SIGUSR1, handler)
+    """)
+    assert lints.lint_signal_safety([ok], str(tmp_path)) == []
+
+
+def test_lint_json_write_fires_and_allows_streams(tmp_path):
+    bad = _snippet(tmp_path, "bad_json.py", """
+        import json
+        import sys
+        with open("x.json", "w") as f:
+            json.dump({"a": 1}, f)
+        json.dump({"a": 1}, sys.stdout)   # streams are fine
+        s = json.dumps({"a": 1})          # strings are fine
+    """)
+    fs = lints.lint_json_writes([bad], str(tmp_path))
+    assert len(fs) == 1 and fs[0].rule == "lint.json-write"
+
+
+def test_lint_fault_seam_fires(tmp_path):
+    bad = _snippet(tmp_path, "bad_seam.py", """
+        from . import faults, metrics
+
+        def seam():
+            try:
+                faults.fire("native_build")
+            except faults.FaultInjected:
+                return None            # swallowed, uncounted
+
+        def bare():
+            try:
+                seam()
+            except:
+                pass
+    """)
+    fs = lints.lint_fault_seams([bad], str(tmp_path))
+    rules = sorted(f.message[:4] for f in fs)
+    assert len(fs) == 2, fs
+    assert any("bare" in f.message for f in fs), rules
+
+
+def test_lint_fault_seam_counted_passes(tmp_path):
+    ok = _snippet(tmp_path, "ok_seam.py", """
+        from . import faults, metrics
+
+        def seam():
+            try:
+                faults.fire("native_build")
+            except faults.FaultInjected:
+                metrics.inc("fault.degraded.native_build")
+                return None
+
+        def reraise():
+            try:
+                seam()
+            except faults.FaultInjected:
+                raise RuntimeError("wrapped")
+    """)
+    assert lints.lint_fault_seams([ok], str(tmp_path)) == []
+
+
+def test_lints_quiet_on_real_tree():
+    assert lints.run_lints(REPO) == []
+
+
+# ---------------------------------------------------------------------------
+# gate wiring
+# ---------------------------------------------------------------------------
+
+
+def test_gate_green_and_writes_report(tmp_path):
+    report = tmp_path / "ANALYSIS_REPORT.json"
+    proc = subprocess.run(
+        [sys.executable, "scripts/analysis_gate.py", "--skip-generative",
+         "--report", str(report)],
+        cwd=REPO, capture_output=True, text=True, timeout=300,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    import json
+
+    doc = json.loads(report.read_text())
+    assert doc["finding_count"] == 0
+    assert doc["passes"]["contracts"]["count"] == 0
+    assert doc["passes"]["lints"]["count"] == 0
+    assert len(doc["knobs"]) >= 40
+    assert doc["sanitizer"] == {"ran": False}
+
+
+def test_gate_red_on_seeded_env_read(tmp_path):
+    """End to end: a rogue PYRUHVRO_TPU_* env read planted in the
+    package makes the gate exit non-zero and name the file. The tree is
+    copied so the real repo is never touched."""
+    work = tmp_path / "repo"
+    for rel in ("pyruhvro_tpu", "scripts", "tests", "README.md",
+                "bench.py"):
+        src = os.path.join(REPO, rel)
+        if os.path.isdir(src):
+            shutil.copytree(
+                src, work / rel,
+                ignore=shutil.ignore_patterns("_spec", "__pycache__",
+                                              "*.so", "*.prof*"))
+        else:
+            work.mkdir(parents=True, exist_ok=True)
+            shutil.copy(src, work / rel)
+    rogue = work / "pyruhvro_tpu/runtime/rogue.py"
+    rogue.write_text(
+        'import os\nX = os.getenv("PYRUHVRO_TPU_ROGUE")\n')
+    proc = subprocess.run(
+        [sys.executable, "scripts/analysis_gate.py", "--skip-generative",
+         "--report", str(tmp_path / "r.json")],
+        cwd=work, capture_output=True, text=True, timeout=300,
+    )
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert "rogue.py" in proc.stdout and "lint.env-read" in proc.stdout
+
+
+def test_no_direct_knob_reads_outside_registry():
+    """The acceptance bullet, asserted directly: zero direct
+    PYRUHVRO_TPU_* environment reads outside runtime/knobs.py."""
+    files = lints.iter_py_files(REPO, ("pyruhvro_tpu",))
+    assert lints.lint_env_reads(files, REPO) == []
+
+
+def test_sanitizer_build_flavor_cache_key(monkeypatch):
+    """The .san flavor compiles to its own cached binary and leaves the
+    default flavor untouched (exactly the .prof contract)."""
+    from pyruhvro_tpu.runtime.native import build
+
+    assert not build._san_active()
+    monkeypatch.setenv("PYRUHVRO_TPU_NATIVE_SAN", "1")
+    assert build._san_active()
+    assert build._SAN_FLAGS[0].startswith("-fsanitize=")
+    # distinct cache paths per flavor
+    assert build._so_path("_x.san") != build._so_path("_x")
+    # under san, the specializer declines (spec cache is flavor-blind)
+    from pyruhvro_tpu.hostpath import specialize
+
+    class _Prog:
+        pass
+
+    assert specialize.load_specialized(_Prog()) is None

@@ -32,7 +32,6 @@ import urllib.request
 import pytest
 
 import pyruhvro_tpu as p
-from pyruhvro_tpu.fallback.io import MalformedAvro
 from pyruhvro_tpu.hostpath import native_available
 from pyruhvro_tpu.runtime import (
     breaker,

@@ -16,7 +16,6 @@ pytestmark = pytest.mark.slowcompile
 import pyruhvro_tpu as pv
 from pyruhvro_tpu.fallback.decoder import decode_to_record_batch
 from pyruhvro_tpu.fallback.encoder import encode_record_batch
-from pyruhvro_tpu.ops import UnsupportedOnDevice
 from pyruhvro_tpu.ops.encode import DeviceEncoder
 from pyruhvro_tpu.schema.cache import get_or_parse_schema
 from pyruhvro_tpu.utils.datagen import (

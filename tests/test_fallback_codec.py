@@ -18,7 +18,6 @@ import pytest
 
 from pyruhvro_tpu.fallback import (
     MalformedAvro,
-    compile_writer,
     decode_records,
     decode_to_record_batch,
     encode_record_batch,

@@ -21,10 +21,8 @@ import pytest
 
 import pyruhvro_tpu as p
 from pyruhvro_tpu.fallback.decoder import (
-    decode_records,
     decode_to_record_batch,
 )
-from pyruhvro_tpu.fallback.io import MalformedAvro
 from pyruhvro_tpu.hostpath import NativeHostCodec, native_available
 from pyruhvro_tpu.schema.cache import get_or_parse_schema
 from pyruhvro_tpu.utils.datagen import random_datums, random_schema

@@ -6,7 +6,6 @@ generated inputs (``fast_decode.rs:945-953``), plus malformed-input and
 golden-datum checks.
 """
 
-import numpy as np
 import pyarrow as pa
 import pytest
 

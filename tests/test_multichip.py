@@ -13,7 +13,6 @@ import pytest
 
 import pyruhvro_tpu as pv
 from pyruhvro_tpu.fallback.decoder import MalformedAvro, decode_to_record_batch
-from pyruhvro_tpu.fallback.io import write_long
 from pyruhvro_tpu.parallel import ShardedDecoder, chunk_mesh
 from pyruhvro_tpu.schema.cache import get_or_parse_schema
 from pyruhvro_tpu.utils.datagen import (

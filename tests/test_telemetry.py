@@ -21,7 +21,6 @@ from pyruhvro_tpu import (
     serialize_record_batch,
     telemetry,
 )
-from pyruhvro_tpu.runtime import metrics
 from pyruhvro_tpu.schema.cache import get_or_parse_schema
 from pyruhvro_tpu.utils.datagen import random_datums
 
