@@ -371,6 +371,21 @@ def _run_case(op, schema, datums, backend, chunks, reps, details,
                 1 for k in snap
                 if k.startswith("vm.op.") and k.endswith("_s"))
             samp_sec["vm_op_scaled_s"] = round(vm_op_s, 6)
+    # memory accounting (ISSUE 12): peak RSS + per-cache footprint at
+    # the end of the case — the byte-side evidence next to the time
+    # side, so a trajectory diff shows "this case grew the executable
+    # cache by N MB" instead of a bare RSS delta
+    mem_sec = None
+    mem = tsnap.get("memory")
+    if mem:
+        mem_sec = {
+            "rss_mb": round((mem.get("rss_bytes") or 0) / (1 << 20), 2),
+            "peak_rss_mb": round(
+                (mem.get("peak_rss_bytes") or 0) / (1 << 20), 2),
+            "tracked_bytes": mem.get("tracked_bytes"),
+            "caches": {k: int(v.get("bytes", 0))
+                       for k, v in (mem.get("caches") or {}).items()},
+        }
     details["results"].append({
         **({"native_prof": native_prof} if native_prof else {}),
         **({"device": device} if device else {}),
@@ -378,6 +393,7 @@ def _run_case(op, schema, datums, backend, chunks, reps, details,
         **({"pool": pool_sec} if pool_sec else {}),
         **({"sampling": samp_sec} if samp_sec else {}),
         **({"fused_decode": fused_sec} if fused_sec else {}),
+        **({"memory": mem_sec} if mem_sec else {}),
         "op": op, "backend": backend, "rows": rows, "chunks": chunks,
         "schema": label or "kafka", "seconds": dt, "records_per_s": rec_s,
         "vs_baseline": rec_s / base,
@@ -567,6 +583,13 @@ def main() -> None:
     ap.add_argument("--mesh-rows", type=int,
                     default=int(os.environ.get("BENCH_MESH_ROWS", 20_000)),
                     help="spoofed-8-device mesh leg row count (0 = skip)")
+    ap.add_argument("--churn-schemas", type=int,
+                    default=int(os.environ.get("BENCH_CHURN_SCHEMAS",
+                                               2_000)),
+                    help="schema-churn leg (ISSUE 12): distinct synthetic "
+                         "schemas streamed around a hot 64-schema working "
+                         "set; reports steady-state RSS and warm-hit rate "
+                         "(0 = skip)")
     ap.add_argument("--matrix", action="store_true", default=True)
     ap.add_argument("--no-matrix", dest="matrix", action="store_false",
                     help="skip the criterion shape matrix + chunk sweep")
@@ -802,6 +825,13 @@ def main() -> None:
         _bench_mesh(args.mesh_rows, details)
         save_details()
 
+    # schema-churn leg (ISSUE 12): thousands of schemas around a hot
+    # working set — subprocess-isolated so the churn population's RSS
+    # baseline is its own process, not this one's accumulated caches
+    if args.churn_schemas:
+        _bench_churn(args.churn_schemas, details)
+        save_details()
+
     # optional fastavro comparison (≙ scripts/benchmark_sweep.py)
     try:
         import fastavro  # noqa: F401
@@ -862,6 +892,40 @@ def _bench_mesh(rows, details):
          f"pack {ph.get('pack_s')}s h2d {ph.get('h2d_s')}s "
          f"launch {ph.get('launch_s')}s d2h {ph.get('d2h_s')}s, "
          f"overlap {ph.get('overlap_frac')}")
+
+
+def _bench_churn(schemas, details):
+    """The schema-churn leg (ISSUE 12): ``scripts/mem_soak.py``'s churn
+    half in a subprocess (fresh RSS baseline), landing steady-state RSS,
+    warm-hit rate and eviction counts as the ``churn`` section."""
+    import subprocess
+    import tempfile
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    out = os.path.join(tempfile.mkdtemp(prefix="pyruhvro_churn_"),
+                       "mem_report.json")
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.join(here, "scripts", "mem_soak.py"),
+             "--schemas", str(schemas), "--skip-decompose", "--out", out],
+            env=dict(os.environ, JAX_PLATFORMS="cpu"),
+            capture_output=True, text=True, timeout=1800,
+        )
+    except (OSError, subprocess.TimeoutExpired) as e:
+        _log(f"[bench] churn leg failed to run: {e!r}")
+        return
+    if proc.returncode != 0 or not os.path.exists(out):
+        _log(f"[bench] churn leg failed rc={proc.returncode}: "
+             f"{proc.stderr[-400:]}")
+        return
+    with open(out, encoding="utf-8") as f:
+        entry = json.load(f).get("churn") or {}
+    details["churn"] = entry
+    _log(f"[bench] churn[{entry.get('schemas')} schemas]: max rss "
+         f"{entry.get('max_rss_mb')} MB "
+         f"({'under' if entry.get('rss_under_high_water') else 'OVER'} "
+         f"high water), warm-hit {entry.get('warm_hit_rate')}, "
+         f"lru evictions {(entry.get('evictions') or {}).get('lru')}")
 
 
 def _bench_pyfallback(schema, datums, reps, details):

@@ -54,6 +54,7 @@ from .runtime import (
     breaker,
     deadline,
     faults,
+    memacct,
     metrics,
     quarantine,
     router,
@@ -781,7 +782,7 @@ def _proc_map(task, payloads, rows):
 def deserialize_array(
     data: Sequence[bytes], schema: str, *, backend: str = "auto",
     on_error: str = "raise", return_errors: bool = False,
-    timeout_s: Optional[float] = None,
+    timeout_s: Optional[float] = None, tenant: Optional[str] = None,
 ) -> pa.RecordBatch:
     """Decode Avro datums into a single RecordBatch
     (≙ ``deserialize_array``, ``src/lib.rs:56-71``).
@@ -806,16 +807,26 @@ def deserialize_array(
     (or ``ChunkedArray`` of either) of datums — the shape
     :func:`serialize_record_batch` returns — in which case the native
     tier reads the array's offsets+data buffers directly (zero-copy
-    ingestion lane; no per-datum Python object is created)."""
+    ingestion lane; no per-datum Python object is created).
+
+    ``tenant``: optional caller identity for memory/heavy-hitter
+    attribution — lands on the call span and in the per-(tenant,
+    schema) sketch behind ``telemetry mem-report`` (ISSUE 12);
+    untagged calls pool under ``"-"``."""
     _check_backend(backend)
     _check_on_error(on_error)
     data = as_datum_input(data)
     entry = get_or_parse_schema(schema)
+    memacct.attribute(tenant, entry.fingerprint, "decode", len(data),
+                      data)
     with telemetry.root_span("api.deserialize_array", rows=len(data),
-                             backend=backend, schema=entry.fingerprint), \
+                             backend=backend, schema=entry.fingerprint,
+                             **({"tenant": tenant} if tenant else {})), \
             sampling.call_scope("decode", entry.fingerprint,
                                 len(data)) as smp, \
             deadline.scope(timeout_s, op="deserialize_array"):
+        # inside the root span so a pressure event annotates THIS call
+        memacct.tick()
         dec = _decide(entry, backend, len(data), op="decode")
         dec.sampled = smp.sampled
         try:
@@ -871,6 +882,7 @@ def deserialize_array_threaded(
     data: Sequence[bytes], schema: str, num_chunks: int, *,
     backend: str = "auto", on_error: str = "raise",
     return_errors: bool = False, timeout_s: Optional[float] = None,
+    tenant: Optional[str] = None,
 ) -> List[pa.RecordBatch]:
     """Decode in ``num_chunks`` chunks → one RecordBatch per chunk
     (≙ ``deserialize_array_threaded``, ``src/lib.rs:73-89``).
@@ -881,8 +893,8 @@ def deserialize_array_threaded(
     (``parallel/sharded.py``); on a single chip the whole input is
     decoded in one fused launch and sliced per chunk.
 
-    ``on_error``/``return_errors``/``timeout_s`` and the pyarrow
-    BinaryArray ingestion lane for ``data``: see
+    ``on_error``/``return_errors``/``timeout_s``/``tenant`` and the
+    pyarrow BinaryArray ingestion lane for ``data``: see
     :func:`deserialize_array`.
     Chunk boundaries are computed on the INPUT rows; under ``"skip"``
     a chunk's batch holds its surviving rows (``"null"`` preserves the
@@ -891,13 +903,17 @@ def deserialize_array_threaded(
     _check_on_error(on_error)
     data = as_datum_input(data)
     entry = get_or_parse_schema(schema)
+    memacct.attribute(tenant, entry.fingerprint, "decode", len(data),
+                      data)
     bounds = chunk_bounds(len(data), num_chunks)
     with telemetry.root_span("api.deserialize_array_threaded",
                              rows=len(data), chunks=num_chunks,
-                             backend=backend, schema=entry.fingerprint), \
+                             backend=backend, schema=entry.fingerprint,
+                             **({"tenant": tenant} if tenant else {})), \
             sampling.call_scope("decode", entry.fingerprint,
                                 len(data)) as smp, \
             deadline.scope(timeout_s, op="deserialize_array_threaded"):
+        memacct.tick()  # inside the root span: pressure annotates it
         dec = _decide(entry, backend, len(data), op="decode",
                       chunks=len(bounds))
         dec.sampled = smp.sampled
@@ -1013,12 +1029,13 @@ def deserialize_array_threaded_spawn(
     data: Sequence[bytes], schema: str, num_chunks: int, *,
     backend: str = "auto", on_error: str = "raise",
     return_errors: bool = False, timeout_s: Optional[float] = None,
+    tenant: Optional[str] = None,
 ) -> List[pa.RecordBatch]:
     """Signature-parity alias of :func:`deserialize_array_threaded`
     (≙ ``src/lib.rs:108-128``; thread-pool flavor is a host-side detail)."""
     return deserialize_array_threaded(
         data, schema, num_chunks, backend=backend, on_error=on_error,
-        return_errors=return_errors, timeout_s=timeout_s,
+        return_errors=return_errors, timeout_s=timeout_s, tenant=tenant,
     )
 
 
@@ -1026,6 +1043,7 @@ def serialize_record_batch(
     batch: pa.RecordBatch, schema: str, num_chunks: int, *,
     backend: str = "auto", on_error: str = "raise",
     return_errors: bool = False, timeout_s: Optional[float] = None,
+    tenant: Optional[str] = None,
 ) -> List[pa.Array]:
     """Encode a RecordBatch into Avro datums, one BinaryArray per chunk
     (≙ ``serialize_record_batch``, ``src/lib.rs:91-106``).
@@ -1046,13 +1064,17 @@ def serialize_record_batch(
             if batches
             else pa.RecordBatch.from_pylist([], schema=batch.schema)
         )
+    memacct.attribute(tenant, entry.fingerprint, "encode",
+                      batch.num_rows, batch)
     bounds = chunk_bounds(batch.num_rows, num_chunks)
     with telemetry.root_span("api.serialize_record_batch",
                              rows=batch.num_rows, chunks=num_chunks,
-                             backend=backend, schema=entry.fingerprint), \
+                             backend=backend, schema=entry.fingerprint,
+                             **({"tenant": tenant} if tenant else {})), \
             sampling.call_scope("encode", entry.fingerprint,
                                 batch.num_rows) as smp, \
             deadline.scope(timeout_s, op="serialize_record_batch"):
+        memacct.tick()  # inside the root span: pressure annotates it
         dec = _decide(entry, backend, batch.num_rows, op="encode",
                       chunks=len(bounds), need_encode=True)
         dec.sampled = smp.sampled
@@ -1149,10 +1171,11 @@ def serialize_record_batch_spawn(
     batch: pa.RecordBatch, schema: str, num_chunks: int, *,
     backend: str = "auto", on_error: str = "raise",
     return_errors: bool = False, timeout_s: Optional[float] = None,
+    tenant: Optional[str] = None,
 ) -> List[pa.Array]:
     """Signature-parity alias of :func:`serialize_record_batch`
     (≙ ``src/lib.rs:130-147``)."""
     return serialize_record_batch(
         batch, schema, num_chunks, backend=backend, on_error=on_error,
-        return_errors=return_errors, timeout_s=timeout_s,
+        return_errors=return_errors, timeout_s=timeout_s, tenant=tenant,
     )

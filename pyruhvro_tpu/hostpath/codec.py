@@ -95,6 +95,7 @@ class NativeHostCodec:
         from ..runtime import knobs
 
         self._spec = None            # the specialized module, once built
+        self._spec_name = None       # its engine-registry key (ISSUE 12)
         # the per-opcode profiler lives in the generic VM's dispatch
         # points; the specialized engines are straight-line code with
         # nothing to attribute, so profiling pins the interpreter
@@ -125,13 +126,17 @@ class NativeHostCodec:
         self._rows_seen += n
         if self._rows_seen < self._spec_rows:
             return
-        from .specialize import load_specialized
+        from .specialize import bind_engine_user, load_specialized
 
         mod = load_specialized(self.prog)
         if mod is None:
             self._spec_failed = True  # no toolchain / build error: probe once
         else:
             self._spec = mod
+            # lifecycle hookup: the engine's LRU clock ticks per decode
+            # and eviction can unhook this codec's reference
+            self._spec_name = mod.__name__
+            bind_engine_user(self._spec_name, self)
 
     def decode(self, data: Sequence[bytes],
                nthreads: int = 0, index_base: int = 0) -> pa.RecordBatch:
@@ -183,10 +188,20 @@ class NativeHostCodec:
             # straight-line module > generic interpreter — each offers
             # the fused wire→Arrow entry unless the knob pins the
             # oracle (or a stale .so predates it)
+            # bind the specialized engine ONCE: a concurrent lifecycle
+            # eviction may null self._spec at any point (the engine
+            # module itself stays valid — eviction only unlinks
+            # references), so the check and the use must read the same
+            # local, never re-read the attribute
+            spec_eng = self._spec
             if deep_mod is not None:
                 eng, generic = deep_mod, True
-            elif self._spec is not None:
-                eng, generic = self._spec, False
+            elif spec_eng is not None:
+                eng, generic = spec_eng, False
+                if self._spec_name:
+                    from .specialize import touch_engine
+
+                    touch_engine(self._spec_name)
             else:
                 eng, generic = self._mod, True
             from ..runtime import knobs
@@ -195,7 +210,7 @@ class NativeHostCodec:
             if not knobs.get_bool("PYRUHVRO_TPU_NO_FUSED_DECODE"):
                 fused = getattr(eng, "decode_arrow", None)
             with telemetry.phase("host.vm_s",
-                                 specialized=(self._spec is not None
+                                 specialized=(spec_eng is not None
                                               and deep_mod is None),
                                  fused=fused is not None):
                 if fused is not None:
@@ -390,9 +405,14 @@ class NativeHostCodec:
             # evidence the lane works); release the slot verdict-free
             br.release()
             return None
-        spec = self._spec if (
-            self._spec is not None and hasattr(self._spec, "encode_arrow")
+        spec_eng = self._spec  # single read: eviction may null it
+        spec = spec_eng if (
+            spec_eng is not None and hasattr(spec_eng, "encode_arrow")
         ) else None
+        if spec is not None and self._spec_name:
+            from .specialize import touch_engine
+
+            touch_engine(self._spec_name)
         mod = None if spec is not None else self._native_extract_mod()
         if spec is None and mod is None:
             return None  # _native_extract_mod already fed the breaker
@@ -560,11 +580,18 @@ class NativeHostCodec:
         # size; past 1 GiB of bound, hint=0 selects the VM's
         # capacity-checked growth path instead of a giant eager alloc
         hint = ex.bound if ex.bound <= (1 << 30) else 0
+        spec_eng = self._spec  # single read: eviction may null it
+        if spec_eng is not None and self._spec_name:
+            # encode-only traffic through this lane must stamp the
+            # engine's LRU clock too, or TTL/LRU evicts the hot engine
+            from .specialize import touch_engine
+
+            touch_engine(self._spec_name)
         try:
             with telemetry.phase("host.encode_vm_s",
-                                 specialized=self._spec is not None):
-                if self._spec is not None:
-                    blob, offs = self._spec.encode(
+                                 specialized=spec_eng is not None):
+                if spec_eng is not None:
+                    blob, offs = spec_eng.encode(
                         self.prog.coltypes, bufs, n, hint, checked
                     )
                 else:

@@ -28,13 +28,17 @@ from __future__ import annotations
 
 import hashlib
 import os
-from typing import List
+import threading
+import time
+import weakref
+from typing import Dict, List
 
 import numpy as np
 
 from .program import HostProgram
 
-__all__ = ["generate_source", "load_specialized"]
+__all__ = ["generate_source", "load_specialized", "touch_engine",
+           "bind_engine_user"]
 
 # ops indices (kind, a, b, col, nops, pad) — see hostpath/program.py
 from .program import (  # noqa: E402  (kept near use for readability)
@@ -752,6 +756,109 @@ def _native_dir() -> str:
     return nb._HERE
 
 
+# -- engine lifecycle / accounting (ISSUE 12) -------------------------------
+#
+# Every loaded specialized engine registers here: its on-disk .so size
+# (the byte-accurate part of what dlopen mapped), an LRU clock, and
+# weak references to the NativeHostCodec instances serving through it.
+# Eviction drops the Python-side references (module memo + each codec's
+# ``_spec``) so the next decode re-admits via ``load_specialized`` —
+# a pure dlopen of the existing disk artifact, never a recompile. The
+# mapped code itself stays resident (CPython never dlcloses extension
+# modules); the registry accounts it either way so the footprint an
+# operator sees matches what RSS holds.
+
+_eng_lock = threading.Lock()
+# mod_name -> {"bytes": so size, "last_used": monotonic, "codecs": WeakSet}
+_engines: Dict[str, dict] = {}
+
+
+def _note_engine(mod_name: str, so_path: str) -> dict:
+    try:
+        size = os.path.getsize(so_path)
+    except OSError:
+        size = 0
+    with _eng_lock:
+        rec = _engines.get(mod_name)
+        if rec is None:
+            rec = _engines[mod_name] = {
+                "bytes": float(size),
+                "last_used": time.monotonic(),
+                "codecs": weakref.WeakSet(),
+            }
+        else:
+            rec["last_used"] = time.monotonic()
+            if size:
+                rec["bytes"] = float(size)
+    return rec
+
+
+def touch_engine(mod_name: str) -> None:
+    """Stamp an engine's LRU clock (called per decode serving through
+    it; a dict store under the GIL, no lock on the hot path)."""
+    rec = _engines.get(mod_name)
+    if rec is not None:
+        rec["last_used"] = time.monotonic()
+
+
+def bind_engine_user(mod_name: str, codec) -> None:
+    """Attach a codec to the engine's user set so eviction can unhook
+    its ``_spec`` reference."""
+    with _eng_lock:
+        rec = _engines.get(mod_name)
+        if rec is not None:
+            rec["codecs"].add(codec)
+
+
+def _engine_entries():
+    with _eng_lock:
+        return [(name, rec["last_used"], rec["bytes"])
+                for name, rec in _engines.items()]
+
+
+def _evict_engine(mod_name: str) -> bool:
+    from ..runtime import metrics
+    from ..runtime.native import build as nb
+
+    with _eng_lock:
+        rec = _engines.pop(mod_name, None)
+    if rec is None:
+        return False
+    nb._modules.pop(mod_name, None)
+    for codec in list(rec["codecs"]):
+        # leave _rows_seen and _spec_failed untouched: the schema is
+        # still hot, so the NEXT decode re-admits through
+        # load_specialized (a disk-cache dlopen, not a g++ run)
+        codec._spec = None
+        codec._spec_name = None
+    metrics.inc("specialize.evictions")
+    return True
+
+
+def _register_lifecycle() -> None:
+    from ..runtime import cachelife, knobs, memacct
+
+    cachelife.register(
+        "engines",
+        entries=_engine_entries,
+        evict=_evict_engine,
+        capacity=lambda: knobs.get_int("PYRUHVRO_TPU_CACHE_MAX_ENGINES"),
+    )
+
+    def _probe():
+        with _eng_lock:
+            return {
+                "bytes": float(sum(r["bytes"]
+                                   for r in _engines.values())),
+                "items": float(len(_engines)),
+            }
+
+    memacct.register_probe("cache.engines", _probe)
+
+
+_register_lifecycle()
+
+
 def load_specialized(prog: HostProgram):
     """Generate + compile + import this program's specialized decoder.
 
@@ -781,16 +888,22 @@ def load_specialized(prog: HostProgram):
             (probe + "\x00" + core_text).encode()
         ).hexdigest()[:12]
         mod_name = f"_pyruhvro_spec_{h}"
-        if mod_name in nb._modules:
-            return nb._modules[mod_name]
+        so = os.path.join(spec_dir, mod_name + nb._ext_suffix())
+        # memo hits read with .get: a concurrent lifecycle eviction may
+        # pop the key between a membership check and the read, and a
+        # swallowed KeyError here would read as "build failed" and pin
+        # the interpreter for the codec's lifetime
+        mod = nb._modules.get(mod_name)
+        if mod is not None:
+            _note_engine(mod_name, so)
+            return mod
         with nb._lock:
-            if mod_name in nb._modules:
-                return nb._modules[mod_name]
+            mod = nb._modules.get(mod_name)
+            if mod is not None:
+                _note_engine(mod_name, so)
+                return mod
             os.makedirs(spec_dir, exist_ok=True)
             src = os.path.join(spec_dir, mod_name + ".cpp")
-            so = os.path.join(
-                spec_dir, mod_name + nb._ext_suffix()
-            )
             if not os.path.exists(src):
                 tmp = f"{src}.{os.getpid()}.tmp"
                 with open(tmp, "w") as f:
@@ -804,6 +917,12 @@ def load_specialized(prog: HostProgram):
             mod = importlib.util.module_from_spec(spec)
             spec.loader.exec_module(mod)
             nb._modules[mod_name] = mod
-            return mod
+        # lifecycle registration + admission OUTSIDE the build lock
+        # (LRU eviction of another engine must not wait on a compile)
+        _note_engine(mod_name, so)
+        from ..runtime import cachelife
+
+        cachelife.admit("engines")
+        return mod
     except Exception:
         return None

@@ -320,10 +320,17 @@ class DeviceDecoder:
         # packer refills in place (slot alternates 0/1 on the
         # double-buffered overlap path; the single-launch path uses 0)
         self._arenas: Dict[tuple, np.ndarray] = {}
+        self._arena_used: Dict[tuple, float] = {}  # LRU clock per arena
         # R buckets whose converged rung was already taught to the
         # capacity planner (re-harvest only after a cap actually grows)
         self._planned: set = set()
         self._lock = threading.Lock()
+        # lifecycle planes (ISSUE 12): executables + arenas enumerate
+        # and evict through the weak holder registry
+        device_obs.track_holder(self)
+
+    def _jit_caches(self):
+        return [self._pipe_cache, self._err_cache]
 
     def _arena(self, R: int, B: int, slot: int = 0) -> np.ndarray:
         """The persistent packed-input host buffer for an (R, B) bucket
@@ -349,12 +356,14 @@ class DeviceDecoder:
                             if k[0] == R and k[2] == slot
                             and k[3] == key[3] and k[1] < B]:
                     del self._arenas[old]
+                    self._arena_used.pop(old, None)
                 buf = self._arenas[key] = np.empty(
                     B // 4 + 2 * R + 1, np.uint32
                 )
                 metrics.inc("device.arena.misses")
             else:
                 metrics.inc("device.arena.hits")
+            self._arena_used[key] = time.monotonic()
         return buf
 
     # -- traced pieces -----------------------------------------------------

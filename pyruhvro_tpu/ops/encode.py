@@ -1314,6 +1314,12 @@ class DeviceEncoder:
         self.fingerprint = fingerprint or "?"  # jit-cache registry id
         self.prog = lower_encoder(ir)  # raises UnsupportedOnDevice
         self._packed_cache: Dict[tuple, object] = {}
+        from ..runtime import device_obs as _dobs
+
+        _dobs.track_holder(self)  # executable lifecycle (ISSUE 12)
+
+    def _jit_caches(self):
+        return [self._packed_cache]
 
     def _program(self):
         prog = self.prog

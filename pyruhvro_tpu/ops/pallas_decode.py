@@ -133,12 +133,16 @@ class PallasKernelDecoder:
         self._caps = None  # remembered successful cap-ladder rung
         self._cache: Dict[Tuple, object] = {}
         self._lock = threading.Lock()
+        device_obs.track_holder(self)  # executable lifecycle (ISSUE 12)
         self.n_regions = len(self.prog.regions)
         # sorted buffer keys define the output tuple order
         self.out_keys = sorted(self.prog.buffers) + ["#err"]
         self._widened = {
             k: self.prog.buffers[k].dtype for k in sorted(self.prog.buffers)
         }
+
+    def _jit_caches(self):
+        return [self._cache]
 
     # -- kernel construction ------------------------------------------------
 

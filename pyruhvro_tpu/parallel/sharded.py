@@ -92,7 +92,12 @@ class ShardedDecoder:
         # persistent [D, W + 2R + 1] packed-input host arenas, one per
         # (R, B) bucket (the sharded mirror of DeviceDecoder._arena)
         self._arenas: Dict[tuple, np.ndarray] = {}
+        self._arena_used: Dict[tuple, float] = {}
         self._lock = threading.Lock()
+        device_obs.track_holder(self)  # lifecycle planes (ISSUE 12)
+
+    def _jit_caches(self):
+        return [self._cache]
 
     def _arena(self, R: int, B: int) -> np.ndarray:
         # thread-keyed like DeviceDecoder._arena: concurrent callers of
@@ -108,12 +113,14 @@ class ShardedDecoder:
                             if k[0] == R and k[2] == key[2]
                             and k[1] < B]:
                     del self._arenas[old]
+                    self._arena_used.pop(old, None)
                 buf = self._arenas[key] = np.empty(
                     (self.D, B // 4 + 2 * R + 1), np.uint32
                 )
                 metrics.inc("device.arena.misses")
             else:
                 metrics.inc("device.arena.hits")
+            self._arena_used[key] = time.monotonic()
         return buf
 
     # -- compiled sharded launch ------------------------------------------

@@ -56,6 +56,10 @@ class ShardedEncoder:
         self.D = int(self.mesh.devices.size)
         self._cache: Dict[tuple, object] = {}
         self._lock = threading.Lock()
+        device_obs.track_holder(self)  # executable lifecycle (ISSUE 12)
+
+    def _jit_caches(self):
+        return [self._cache]
 
     def _sharded_fn(self, entries: tuple, cap: int):
         """Jit of ``shard_map(per-chunk encode)`` over ONE packed

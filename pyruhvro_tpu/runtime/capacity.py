@@ -205,3 +205,32 @@ def reset() -> None:
     ``costmodel.reset()``). Does not touch the on-disk profile."""
     with _lock:
         _plans.clear()
+
+
+# -- memory accounting (ISSUE 12): the planner's own footprint -------------
+
+
+def footprint_bytes() -> int:
+    """Estimated host bytes held by the planner (per-key dict/str
+    overhead estimates; the values are small ints)."""
+    with _lock:
+        n = 0
+        for (fp, _R), plan in _plans.items():
+            n += 160 + len(fp)
+            n += sum(len(p) + 64 for p in plan["item_caps"])
+            n += sum(len(p) + 64 for p in plan["tot_caps"])
+            n += 64 * len(plan["str_full_B"])
+        return n
+
+
+def _register_probe() -> None:
+    from . import memacct
+
+    memacct.register_probe(
+        "capacity",
+        lambda: {"bytes": float(footprint_bytes()),
+                 "items": float(len(_plans))},
+    )
+
+
+_register_probe()

@@ -46,6 +46,7 @@ from __future__ import annotations
 
 import threading
 import time
+import weakref
 from collections import deque
 from typing import Any, Dict, Optional, Tuple
 
@@ -58,6 +59,7 @@ __all__ = [
     "snapshot",
     "reset",
     "sync_mode",
+    "track_holder",
 ]
 
 _lock = threading.Lock()
@@ -67,6 +69,25 @@ _registry: Dict[Tuple[str, str, str], Dict[str, Any]] = {}
 _memory: Dict[str, Dict[str, Any]] = {}
 # fingerprint -> monotonic timestamps of recent compiles (churn window)
 _compile_log: Dict[str, deque] = {}
+
+# the objects whose dicts actually pin jit executables and host arenas
+# (DeviceDecoder, ShardedDecoder, DeviceEncoder, ShardedEncoder):
+# weak-tracked so the lifecycle planes (ISSUE 12) can enumerate and
+# evict without keeping any pipeline alive themselves
+_holders: "weakref.WeakSet" = weakref.WeakSet()
+
+# when no memory_analysis is available for an executable, account this
+# much per registry row (explicit estimate, documented in README)
+_EXE_EST_BYTES = 64 * 1024
+
+
+def track_holder(holder) -> None:
+    """Register a pipeline/arena holder for the lifecycle planes. The
+    holder contract is duck-typed and optional per plane: a
+    ``_jit_caches()`` method returning the dicts whose values are (or
+    contain) :class:`InstrumentedJit` instances, and/or ``_arenas`` +
+    ``_arena_used`` dicts guarded by ``_lock``."""
+    _holders.add(holder)
 
 
 def churn_window_s() -> float:
@@ -134,12 +155,14 @@ def _entry(key: Tuple[str, str, str]) -> Dict[str, Any]:
             "launches": 0,
             "compile_s": 0.0,
             "launch_s": 0.0,
+            "last_used": time.monotonic(),
         }
     return e
 
 
 def note_compile(fingerprint: str, kind: str, bucket: str, seconds: float,
-                 cost: Optional[Dict[str, float]] = None) -> None:
+                 cost: Optional[Dict[str, float]] = None,
+                 mem_bytes: Optional[int] = None) -> None:
     """Record one compile in the registry and feed the churn guard.
 
     The guard counts compiles per schema fingerprint inside a sliding
@@ -153,8 +176,11 @@ def note_compile(fingerprint: str, kind: str, bucket: str, seconds: float,
         e = _entry((fingerprint, kind, bucket))
         e["compiles"] += 1
         e["compile_s"] = round(e["compile_s"] + seconds, 9)
+        e["last_used"] = now
         if cost:
             e["cost"] = cost
+        if mem_bytes:
+            e["mem_bytes"] = int(mem_bytes)
         log = _compile_log.setdefault(fingerprint, deque())
         log.append(now)
         window = churn_window_s()
@@ -175,6 +201,12 @@ def note_compile(fingerprint: str, kind: str, bucket: str, seconds: float,
         from . import costmodel
 
         costmodel.penalize(fingerprint, churn_window_s())
+    # admission control for the executable registry (OUTSIDE _lock:
+    # eviction re-enters it): past CACHE_MAX_EXECUTABLES the
+    # least-recently-used executable is dropped
+    from . import cachelife
+
+    cachelife.admit("executables")
 
 
 def _note_launch(fingerprint: str, kind: str, bucket: str,
@@ -183,11 +215,14 @@ def _note_launch(fingerprint: str, kind: str, bucket: str,
         e = _entry((fingerprint, kind, bucket))
         e["launches"] += 1
         e["launch_s"] = round(e["launch_s"] + seconds, 9)
+        e["last_used"] = time.monotonic()
 
 
 def _note_hit(fingerprint: str, kind: str, bucket: str) -> None:
     with _lock:
-        _entry((fingerprint, kind, bucket))["hits"] += 1
+        e = _entry((fingerprint, kind, bucket))
+        e["hits"] += 1
+        e["last_used"] = time.monotonic()
 
 
 def note_memory(jax) -> None:
@@ -370,7 +405,7 @@ class InstrumentedJit:
         telemetry.observe("device.compile_s", dt, kind=self.kind,
                           bucket=self.bucket)
         note_compile(self.fingerprint, self.kind, self.bucket, dt,
-                     cost=self._cost(exe))
+                     cost=self._cost(exe), mem_bytes=self._mem(exe))
         self._exe = exe
         self._aot = True
         return self._launch(args)
@@ -466,6 +501,27 @@ class InstrumentedJit:
             return None
         return {"flops": flops, "bytes_accessed": byts}
 
+    def _mem(self, exe) -> Optional[int]:
+        """XLA ``memory_analysis()`` footprint of a compiled executable
+        (code + argument + output + temp bytes) — the byte-accurate
+        input to the ``cache.executables`` accounting plane. None where
+        the backend/JAX version lacks the API (an estimate serves)."""
+        try:
+            ma = exe.memory_analysis()
+        except Exception:
+            return None
+        total = 0
+        for attr in ("generated_code_size_in_bytes",
+                     "argument_size_in_bytes",
+                     "output_size_in_bytes",
+                     "temp_size_in_bytes",
+                     "alias_size_in_bytes"):
+            try:
+                total += int(getattr(ma, attr, 0) or 0)
+            except (TypeError, ValueError):
+                continue
+        return total or None
+
 
 # ---------------------------------------------------------------------------
 # export / reset
@@ -495,3 +551,127 @@ def reset() -> None:
         _registry.clear()
         _memory.clear()
         _compile_log.clear()
+
+
+# ---------------------------------------------------------------------------
+# lifecycle planes (ISSUE 12): jit executables + host arenas
+# ---------------------------------------------------------------------------
+
+
+def _exe_entries():
+    with _lock:
+        return [
+            ("|".join(k), e.get("last_used", 0.0),
+             e.get("mem_bytes") or _EXE_EST_BYTES)
+            for k, e in _registry.items()
+        ]
+
+
+def _holder_lock(h):
+    lock = getattr(h, "_lock", None)
+    return lock if lock is not None else threading.Lock()
+
+
+def _evict_executable(key_str: str) -> bool:
+    """Drop one executable: the registry row AND every holder cache
+    slot whose :class:`InstrumentedJit` carries the same (fingerprint,
+    kind, bucket) — the next call through that bucket recompiles
+    (a fresh cache miss, so misses == actual compiles stays true)."""
+    try:
+        fingerprint, kind, bucket = key_str.split("|", 2)
+    except ValueError:
+        return False
+    with _lock:
+        gone = _registry.pop((fingerprint, kind, bucket), None)
+    if gone is None:
+        return False
+    for h in list(_holders):
+        caches = getattr(h, "_jit_caches", None)
+        if caches is None:
+            continue
+        with _holder_lock(h):
+            for cache in caches():
+                for k in list(cache):
+                    v = cache.get(k)
+                    fn = v[0] if isinstance(v, tuple) else v
+                    if (isinstance(fn, InstrumentedJit)
+                            and fn.fingerprint == fingerprint
+                            and fn.kind == kind
+                            and fn.bucket == bucket):
+                        del cache[k]
+    metrics.inc("device.jit_cache.evictions")
+    return True
+
+
+def _arena_entries():
+    out = []
+    for h in list(_holders):
+        arenas = getattr(h, "_arenas", None)
+        if arenas is None:
+            continue
+        used = getattr(h, "_arena_used", None) or {}
+        with _holder_lock(h):
+            for key, buf in arenas.items():
+                out.append(((id(h), key), used.get(key, 0.0),
+                            getattr(buf, "nbytes", 0)))
+    return out
+
+
+def _evict_arena(ent_key) -> bool:
+    hid, key = ent_key
+    for h in list(_holders):
+        if id(h) != hid:
+            continue
+        arenas = getattr(h, "_arenas", None)
+        if arenas is None:
+            return False
+        with _holder_lock(h):
+            gone = arenas.pop(key, None)
+            used = getattr(h, "_arena_used", None)
+            if used is not None:
+                used.pop(key, None)
+        if gone is not None:
+            metrics.inc("device.arena.evictions")
+            return True
+        return False
+    return False
+
+
+def _register_lifecycle() -> None:
+    from . import cachelife, memacct
+
+    cachelife.register(
+        "executables",
+        entries=_exe_entries,
+        evict=_evict_executable,
+        capacity=lambda: knobs.get_int(
+            "PYRUHVRO_TPU_CACHE_MAX_EXECUTABLES"),
+    )
+    # arenas have no entry cap of their own (each decoder already keeps
+    # only the largest B per (R, slot, thread)); TTL + pressure manage
+    # them
+    cachelife.register(
+        "arenas",
+        entries=_arena_entries,
+        evict=_evict_arena,
+    )
+
+    def _exe_probe():
+        ents = _exe_entries()
+        return {
+            "bytes": float(sum(b for _k, _t, b in ents)),
+            "items": float(len(ents)),
+        }
+
+    def _arena_probe():
+        ents = _arena_entries()
+        return {
+            "bytes": float(sum(b for _k, _t, b in ents)),
+            "items": float(len(ents)),
+        }
+
+    memacct.register_probe("cache.executables", _exe_probe)
+    memacct.register_probe("cache.arenas", _arena_probe)
+
+
+_register_lifecycle()

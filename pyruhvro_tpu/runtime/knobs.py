@@ -210,6 +210,29 @@ _reg("PYRUHVRO_TPU_CAPACITY_PERSIST", "bool", False,
      "Persist learned device-capacity plans into ROUTING_PROFILE even "
      "without autotune.")
 
+# ---- memory accounting / cache lifecycle ----------------------------------
+_reg("PYRUHVRO_TPU_MEM_HIGH_WATER", "int", 0,
+     "Process RSS high-water mark in bytes: crossing it marks the "
+     "mem_pressure health bit, auto-dumps the flight recorder and "
+     "evicts LRU cache entries until the overage is covered (0 = off).")
+_reg("PYRUHVRO_TPU_CACHE_TTL_S", "float", 0.0,
+     "Idle TTL in seconds for schema-keyed cache entries (schema cache, "
+     "specialized engines, jit executables, device arenas); swept "
+     "opportunistically on API calls (0 = no TTL eviction).")
+_reg("PYRUHVRO_TPU_CACHE_MAX_SCHEMAS", "int", 4096,
+     "Schema-cache admission cap: inserting past this many entries "
+     "evicts the least-recently-used schema (0 = unbounded).")
+_reg("PYRUHVRO_TPU_CACHE_MAX_ENGINES", "int", 256,
+     "Loaded specialized-engine cap (schema-specialized .so modules); "
+     "past it the least-recently-used engine is evicted (0 = "
+     "unbounded; the on-disk build cache is never touched).")
+_reg("PYRUHVRO_TPU_CACHE_MAX_EXECUTABLES", "int", 1024,
+     "Device jit-executable cap across all pipelines; past it the "
+     "least-recently-used executable is evicted (0 = unbounded).")
+_reg("PYRUHVRO_TPU_MEM_TOPK", "int", 64,
+     "Heavy-hitter sketch size for per-(tenant, schema) memory "
+     "attribution (space-saving top-k).")
+
 
 # ---------------------------------------------------------------------------
 # accessors

@@ -1,0 +1,449 @@
+"""Memory accounting: byte-level footprint telemetry for every cache.
+
+The observability stack (PRs 1/3/5/6/7) decomposes *time* to >=90%;
+this module does the same for *bytes* (ISSUE 12). Every long-lived
+structure — schema cache, specialized-engine registry, jit-executable
+registry, device arenas, capacity planner, routing profile, the
+flight/ledger rings — self-reports its footprint through a **probe
+registry**, and :func:`collect` publishes the results as **gauges**
+(``mem.<name>.bytes`` / ``mem.<name>.items``) next to process RSS and
+per-device ``memory_stats()`` watermarks. ``telemetry.snapshot()``
+carries the whole picture as the ``memory`` section, rendered by
+``python -m pyruhvro_tpu.telemetry mem-report`` and served live at the
+obs server's ``/memory`` endpoint.
+
+Three jobs beyond plain accounting:
+
+* **decomposition check** — :func:`snapshot_memory` reports
+  ``tracked_bytes`` next to ``rss_bytes`` so the soak harness
+  (``scripts/mem_soak.py``) can assert that tracked footprint explains
+  steady-state RSS growth instead of letting a serving replica die of
+  invisible bytes;
+* **pressure** — :func:`tick` (one call per API entry, throttled)
+  compares RSS against ``PYRUHVRO_TPU_MEM_HIGH_WATER``; crossing it
+  counts ``mem.pressure``, marks the ``mem_pressure`` health bit,
+  auto-dumps the flight recorder and asks :mod:`.cachelife` to evict
+  the overage in global LRU order;
+* **attribution** — every API call feeds a space-saving **top-k
+  heavy-hitter sketch** keyed (tenant, schema fingerprint): calls,
+  rows and approximate input bytes, so "which tenant's schemas own
+  this replica's memory" is one ``mem-report`` away. The ``tenant=``
+  kwarg on the public API threads the id through; untagged calls pool
+  under ``"-"``.
+
+Byte accuracy policy: exact where a buffer protocol gives it to us
+(numpy ``nbytes``, ``.so`` file sizes, pyarrow ``RecordBatch.nbytes``,
+XLA ``memory_analysis()``), explicit estimates elsewhere (parsed
+schema IR, ring records) — an estimate that is visible beats an exact
+number that never gets computed.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+from . import cachelife, knobs, metrics
+
+__all__ = [
+    "register_probe",
+    "rss_bytes",
+    "peak_rss_bytes",
+    "collect",
+    "tracked_bytes",
+    "snapshot_memory",
+    "attribute",
+    "tick",
+    "high_water_bytes",
+    "render_mem_report",
+    "reset",
+]
+
+_lock = threading.Lock()
+_probes: Dict[str, Callable[[], Dict[str, float]]] = {}
+
+# estimates for ring records whose true per-entry size would need a
+# json.dumps per snapshot to measure (documented, deliberately coarse)
+RING_RECORD_EST_BYTES = 512
+
+
+def register_probe(name: str, fn: Callable[[], Dict[str, float]]) -> None:
+    """Register (idempotent by name) a footprint probe: ``fn()`` returns
+    at least ``{"bytes": float}`` and optionally ``"items"``. Probes run
+    at snapshot time and must be cheap and exception-safe — a raising
+    probe is skipped and counted ``mem.probe_error``."""
+    with _lock:
+        _probes[name] = fn
+
+
+# ---------------------------------------------------------------------------
+# process RSS
+# ---------------------------------------------------------------------------
+
+_PAGE = os.sysconf("SC_PAGE_SIZE") if hasattr(os, "sysconf") else 4096
+
+
+def rss_bytes() -> int:
+    """Current resident set size in bytes (``/proc/self/statm`` on
+    Linux; 0 where unavailable — callers treat 0 as "unknown")."""
+    try:
+        with open("/proc/self/statm", "rb") as f:
+            return int(f.read().split()[1]) * _PAGE
+    except (OSError, IndexError, ValueError):
+        return 0
+
+
+def peak_rss_bytes() -> int:
+    """Peak RSS (``ru_maxrss``; kilobytes on Linux)."""
+    try:
+        import resource
+
+        return resource.getrusage(resource.RUSAGE_SELF).ru_maxrss * 1024
+    except Exception:
+        return 0
+
+
+def high_water_bytes() -> int:
+    return max(0, knobs.get_int("PYRUHVRO_TPU_MEM_HIGH_WATER") or 0)
+
+
+# ---------------------------------------------------------------------------
+# collection -> gauges
+# ---------------------------------------------------------------------------
+
+
+# collect() memoizes for a short interval: RSS and footprints are
+# time-varying, and publishing a fresh sample per render would break
+# the PR 7 contract that a /metrics scrape is byte-identical to
+# telemetry.prometheus() on the same registry state (two back-to-back
+# renders must see the SAME gauge values). One probe walk per second
+# is also simply cheaper under scrape + snapshot + report traffic.
+_COLLECT_TTL_S = 1.0
+_collect_lock = threading.Lock()
+_collect_memo: Optional[tuple] = None  # (monotonic, caches, rss)
+
+
+def _collect_full(force: bool = False):
+    """-> (caches, rss_bytes), memoized for ``_COLLECT_TTL_S``."""
+    global _collect_memo
+    now = time.monotonic()
+    with _collect_lock:
+        memo = _collect_memo
+        if not force and memo is not None and now - memo[0] < _COLLECT_TTL_S:
+            return memo[1], memo[2]
+    with _lock:
+        probes = list(_probes.items())
+    out: Dict[str, Dict[str, float]] = {}
+    total = 0.0
+    for name, fn in probes:
+        try:
+            res = fn() or {}
+            b = float(res.get("bytes", 0.0) or 0.0)
+        except Exception:
+            metrics.inc("mem.probe_error")
+            continue
+        out[name] = res
+        total += b
+        metrics.set_gauge(f"mem.{name}.bytes", b)
+        if "items" in res:
+            metrics.set_gauge(f"mem.{name}.items", float(res["items"]))
+    rss = rss_bytes()
+    metrics.set_gauge("mem.rss_bytes", float(rss))
+    metrics.set_gauge("mem.tracked_bytes", total)
+    with _collect_lock:
+        _collect_memo = (now, out, rss)
+    return out, rss
+
+
+def collect(force: bool = False) -> Dict[str, Dict[str, float]]:
+    """Run every probe (at most once per ``_COLLECT_TTL_S``; pass
+    ``force=True`` to bypass the memo), publish ``mem.*`` gauges,
+    return the per-cache results. Called from ``telemetry.snapshot()``
+    so every export sees current-within-a-second footprints."""
+    return _collect_full(force)[0]
+
+
+def tracked_bytes() -> int:
+    """Sum of every probe's current byte footprint (no gauge writes)."""
+    with _lock:
+        probes = list(_probes.values())
+    total = 0.0
+    for fn in probes:
+        try:
+            total += float((fn() or {}).get("bytes", 0.0) or 0.0)
+        except Exception:
+            metrics.inc("mem.probe_error")
+    return int(total)
+
+
+def _device_memory() -> Dict[str, Any]:
+    """Per-device memory_stats watermarks, from the device-obs registry
+    only (never initializes JAX)."""
+    try:
+        from . import device_obs
+
+        return (device_obs.snapshot() or {}).get("memory") or {}
+    except Exception:
+        return {}
+
+
+def snapshot_memory() -> Dict[str, Any]:
+    """The ``memory`` section of ``telemetry.snapshot()``: RSS + peak,
+    tracked total, per-cache footprints, lifecycle summary (live
+    entries / capacity per managed cache), per-device watermarks,
+    high-water configuration and the heavy-hitter attribution table.
+    Caches and RSS come from the same memoized :func:`collect` pass,
+    so the section is internally consistent with the gauges."""
+    caches, rss = _collect_full()
+    tracked = int(sum(float(c.get("bytes", 0) or 0)
+                      for c in caches.values()))
+    out: Dict[str, Any] = {
+        "rss_bytes": rss,
+        "peak_rss_bytes": peak_rss_bytes(),
+        "tracked_bytes": tracked,
+        "caches": {k: {kk: (int(vv) if isinstance(vv, float)
+                            and float(vv).is_integer() else vv)
+                       for kk, vv in v.items()}
+                   for k, v in sorted(caches.items())},
+        "lifecycle": cachelife.snapshot_lifecycle(),
+    }
+    hw = high_water_bytes()
+    if hw:
+        out["high_water_bytes"] = hw
+        out["over_high_water"] = bool(rss and rss > hw)
+    dev = _device_memory()
+    if dev:
+        out["devices"] = dev
+    tenants = _sketch.snapshot()
+    if tenants:
+        out["tenants"] = tenants
+    return out
+
+
+# ---------------------------------------------------------------------------
+# per-(tenant, schema) heavy-hitter attribution
+# ---------------------------------------------------------------------------
+
+
+class _SpaceSaving:
+    """Space-saving top-k: bounded-memory heavy hitters over the
+    (tenant, schema fingerprint) call stream. When the table is full, a
+    new key replaces the minimum-weight row and inherits its weight as
+    the classical over-estimate bound (kept as ``err``)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._rows: Dict[tuple, Dict[str, float]] = {}
+
+    def _k(self) -> int:
+        return max(1, knobs.get_int("PYRUHVRO_TPU_MEM_TOPK") or 64)
+
+    def note(self, tenant: str, schema: str, op: str, rows: int,
+             nbytes: int) -> None:
+        key = (tenant, schema)
+        with self._lock:
+            row = self._rows.get(key)
+            if row is None:
+                k = self._k()
+                if len(self._rows) >= k:
+                    victim = min(self._rows,
+                                 key=lambda r: self._rows[r]["bytes"])
+                    inherited = self._rows.pop(victim)
+                    row = {"calls": 0.0, "rows": 0.0,
+                           "bytes": inherited["bytes"],
+                           "err": inherited["bytes"]}
+                else:
+                    row = {"calls": 0.0, "rows": 0.0, "bytes": 0.0,
+                           "err": 0.0}
+                self._rows[key] = row
+            row["calls"] += 1
+            row["rows"] += rows
+            row["bytes"] += nbytes
+            row[f"{op}_calls"] = row.get(f"{op}_calls", 0.0) + 1
+
+    def snapshot(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            rows = [
+                {"tenant": t, "schema": s,
+                 **{k: int(v) for k, v in r.items()}}
+                for (t, s), r in self._rows.items()
+            ]
+        rows.sort(key=lambda r: -r["bytes"])
+        return rows
+
+    def reset(self) -> None:
+        with self._lock:
+            self._rows.clear()
+
+
+_sketch = _SpaceSaving()
+
+
+def _approx_bytes(payload) -> int:
+    """Cheap input-size estimate for attribution: exact for pyarrow
+    batches (``nbytes``) and arrow-ingested datum views (vectorized
+    offsets diff); sampled (first 64 datums x n) for plain sequences —
+    an O(1) estimate, never an O(n) pass on the hot path."""
+    if payload is None:
+        return 0
+    try:
+        if hasattr(payload, "lens"):  # runtime.ingest.DatumView
+            lens = payload.lens()
+            return int(lens.sum()) if len(lens) else 0
+        if hasattr(payload, "nbytes"):  # pa.RecordBatch / numpy
+            return int(payload.nbytes)
+        n = len(payload)
+        if not n:
+            return 0
+        k = min(n, 64)
+        sample = sum(len(payload[i]) for i in range(k))
+        return int(sample * (n / k))
+    except Exception:
+        return 0
+
+
+def attribute(tenant: Optional[str], schema_fp: str, op: str, rows: int,
+              payload=None) -> None:
+    """Feed one API call into the heavy-hitter sketch (untagged calls
+    pool under tenant ``"-"``)."""
+    _sketch.note(tenant or "-", schema_fp, op, int(rows),
+                 _approx_bytes(payload))
+
+
+# ---------------------------------------------------------------------------
+# the per-call tick: TTL sweep + high-water pressure
+# ---------------------------------------------------------------------------
+
+_TICK_MIN_INTERVAL_S = 1.0
+
+_tick_lock = threading.Lock()
+_tick_last = 0.0
+
+
+def tick() -> None:
+    """Opportunistic lifecycle tick, called once per public API call:
+    throttled to at most one real pass per ``_TICK_MIN_INTERVAL_S``,
+    it runs the TTL sweep and the high-water pressure check. The
+    throttled fast path costs one lock + one ``monotonic()`` read; a
+    real pass with both knobs off costs two env reads on top."""
+    global _tick_last
+    with _tick_lock:
+        now = time.monotonic()
+        if now - _tick_last < _TICK_MIN_INTERVAL_S:
+            return
+        _tick_last = now
+    if cachelife.ttl_s() > 0:
+        cachelife.sweep(now)
+    hw = high_water_bytes()
+    if not hw:
+        return
+    rss = rss_bytes()
+    if not rss or rss <= hw:
+        return
+    metrics.inc("mem.pressure")
+    metrics.mark("mem_pressure")
+    evicted, freed = cachelife.relieve(rss - hw)
+    metrics.inc("mem.pressure_evicted", evicted)
+    from . import telemetry
+
+    telemetry.annotate_root(mem_pressure=True)
+    telemetry._flight_autodump("mem_high_water")
+
+
+def force_pressure_check() -> None:
+    """Un-throttled pressure/TTL pass (tests, the soak harness)."""
+    global _tick_last
+    with _tick_lock:
+        _tick_last = 0.0
+    tick()
+
+
+# ---------------------------------------------------------------------------
+# mem-report rendering (CLI: python -m pyruhvro_tpu.telemetry mem-report)
+# ---------------------------------------------------------------------------
+
+
+def render_mem_report(snap: Dict[str, Any]) -> str:
+    """Human rendering of a snapshot's ``memory`` section (+ the
+    eviction counters that explain how it got that way). Degrades with
+    a one-line note on snapshots that predate the section."""
+    # the report CLI's byte formatter, shared so the two renderings
+    # can never diverge (deferred: telemetry imports this module)
+    from .telemetry import _fmt_bytes
+
+    mem = snap.get("memory")
+    counters = snap.get("counters") or {}
+    out: List[str] = []
+    if not mem:
+        return ("no memory section in this snapshot (predates the "
+                "memory accounting plane)\n")
+    out.append("== memory ==")
+    rss = mem.get("rss_bytes") or 0
+    tracked = mem.get("tracked_bytes") or 0
+    line = (f"rss {_fmt_bytes(rss)} (peak "
+            f"{_fmt_bytes(mem.get('peak_rss_bytes') or 0)}); tracked "
+            f"{_fmt_bytes(tracked)}")
+    if rss:
+        line += f" = {tracked / rss * 100:.1f}% of rss"
+    out.append(line)
+    hw = mem.get("high_water_bytes")
+    if hw:
+        state = "OVER" if mem.get("over_high_water") else "under"
+        out.append(f"high water {_fmt_bytes(hw)} ({state}); pressure "
+                   f"events {counters.get('mem.pressure', 0):.0f}")
+    caches = mem.get("caches") or {}
+    life = mem.get("lifecycle") or {}
+    if caches:
+        out.append("")
+        out.append(f"{'cache':<22} {'bytes':>12} {'items':>8} "
+                   f"{'live':>6} {'cap':>6} "
+                   f"{'lru':>6} {'ttl':>6} {'press':>6}")
+        for name in sorted(caches):
+            c = caches[name]
+            lf = life.get(name.split(".", 1)[-1]) or {}
+            short = name.split(".", 1)[-1]
+            ev = [counters.get(f"cache.evict.{short}.{cause}", 0)
+                  for cause in ("lru", "ttl", "pressure")]
+            out.append(
+                f"{name:<22} {_fmt_bytes(c.get('bytes', 0)):>12} "
+                f"{c.get('items', '-')!s:>8} "
+                f"{lf.get('entries', '-')!s:>6} "
+                f"{lf.get('capacity', '-')!s:>6} "
+                f"{ev[0]:>6.0f} {ev[1]:>6.0f} {ev[2]:>6.0f}"
+            )
+    devices = mem.get("devices") or {}
+    for dev_id, m in sorted(devices.items()):
+        out.append(
+            f"device[{dev_id}]: in use "
+            f"{_fmt_bytes(m.get('bytes_in_use', 0))}, peak "
+            f"{_fmt_bytes(m.get('peak_bytes_in_use', 0))}"
+        )
+    tenants = mem.get("tenants") or []
+    if tenants:
+        out.append("")
+        out.append("== heavy hitters (tenant, schema) ==")
+        out.append(f"{'tenant':<16} {'schema':<14} {'calls':>8} "
+                   f"{'rows':>12} {'bytes':>12}")
+        for row in tenants[:16]:
+            out.append(
+                f"{str(row.get('tenant', '-')):<16} "
+                f"{str(row.get('schema', '?')):<14} "
+                f"{row.get('calls', 0):>8} {row.get('rows', 0):>12} "
+                f"{_fmt_bytes(row.get('bytes', 0)):>12}"
+            )
+        if len(tenants) > 16:
+            out.append(f"  ... {len(tenants) - 16} more")
+    return "\n".join(out) + "\n"
+
+
+def reset() -> None:
+    """Clear the attribution sketch, the tick throttle and the collect
+    memo (test isolation; probes are module wiring and survive)."""
+    global _tick_last, _collect_memo
+    _sketch.reset()
+    with _tick_lock:
+        _tick_last = 0.0
+    with _collect_lock:
+        _collect_memo = None

@@ -11,6 +11,12 @@ phase alongside a ``jax.profiler`` trace.
 Counters are cumulative floats keyed by ``"component.event"``
 (e.g. ``decode.compiles``, ``decode.d2h_bytes``). Cheap enough to stay
 always-on: one lock + dict add per event, host-side only.
+
+Gauges (ISSUE 12) are the second primitive: a LAST-VALUE store for
+facts that go down as well as up — cache footprints, RSS, live entry
+counts. They export through the same snapshot pipeline as counters but
+as ``# TYPE ... gauge`` in the Prometheus exposition (a footprint
+summed as ``_total`` would be nonsense on a scrape graph).
 """
 
 from __future__ import annotations
@@ -21,10 +27,12 @@ from collections import defaultdict
 from typing import Dict
 
 __all__ = ["inc", "merge", "snapshot", "reset", "timer", "record_deltas",
-           "mark", "mark_age", "DeferredCount", "register_flush_hook"]
+           "mark", "mark_age", "DeferredCount", "register_flush_hook",
+           "set_gauge", "gauges"]
 
 _lock = threading.Lock()
 _counters: Dict[str, float] = defaultdict(float)
+_gauges: Dict[str, float] = {}
 _marks: Dict[str, float] = {}
 _tls = threading.local()
 
@@ -71,6 +79,21 @@ class record_deltas:
             for k, v in self.delta.items():
                 self._prev[k] = self._prev.get(k, 0.0) + v
         return False
+
+
+def set_gauge(key: str, value: float) -> None:
+    """Set a last-value gauge (cache bytes, RSS, live entry counts).
+    Same cost model as :func:`inc`: one lock + dict store. Gauges are
+    NOT folded into worker deltas — a worker's footprint is its own
+    process's fact, not an increment the parent should sum."""
+    with _lock:
+        _gauges[key] = float(value)
+
+
+def gauges() -> Dict[str, float]:
+    """A copy of every gauge's current value."""
+    with _lock:
+        return dict(_gauges)
 
 
 def mark(key: str) -> None:
@@ -155,6 +178,7 @@ def snapshot() -> Dict[str, float]:
 def reset() -> None:
     with _lock:
         _counters.clear()
+        _gauges.clear()
         _marks.clear()
 
 

@@ -14,7 +14,10 @@ telemetry registry per request:
   drift makes the process unhealthy (see :func:`health`);
 * ``GET /snapshot`` — the full ``telemetry.snapshot()``
   (schema_version 2) as JSON;
-* ``GET /flight`` — the flight recorder ring (``telemetry.flight_dump()``).
+* ``GET /flight`` — the flight recorder ring (``telemetry.flight_dump()``);
+* ``GET /memory`` — the live memory accounting section
+  (``memacct.snapshot_memory()``: RSS, per-cache footprints, lifecycle
+  state, per-tenant heavy hitters — ISSUE 12).
 
 Enable with ``PYRUHVRO_TPU_OBS_PORT=<port>`` (``0`` = any free port; the
 chosen port is logged and available as ``server().port``) — the server
@@ -118,6 +121,10 @@ def health() -> Tuple[int, Dict[str, Any]]:
         "recompile_storm": recent("recompile_storm"),
         "latency_drift": recent("latency_drift"),
         "slo_breach": bool(slo_breached),
+        # RSS crossed PYRUHVRO_TPU_MEM_HIGH_WATER within the window
+        # (the pressure evictor fires on the same signal — unhealthy
+        # means "pressure happened recently", not "still over")
+        "mem_pressure": recent("mem_pressure"),
     }
     # non-closed circuit breakers are degradation facts: the process
     # still answers (the degraded path serves), so they stay 200, but a
@@ -206,11 +213,24 @@ class _Handler(BaseHTTPRequestHandler):
                     from . import telemetry
 
                     self._send_json(200, telemetry.flight_dump())
+            elif path == "/memory":
+                if snap_doc is not None:
+                    mem = snap_doc.get("memory")
+                    self._send_json(
+                        200, mem if mem is not None else {
+                            "static": True,
+                            "note": "snapshot predates the memory "
+                                    "accounting plane",
+                        })
+                else:
+                    from . import memacct
+
+                    self._send_json(200, memacct.snapshot_memory())
             else:
                 self._send_json(404, {
                     "error": f"unknown path {path!r}",
                     "endpoints": ["/metrics", "/healthz", "/snapshot",
-                                  "/flight"],
+                                  "/flight", "/memory"],
                 })
         except BrokenPipeError:
             pass  # scraper went away mid-response
@@ -344,5 +364,6 @@ def start_from_env() -> Optional[ObsServer]:
     import sys
 
     print(f"[pyruhvro_tpu] obs server listening on {srv.url} "
-          "(/metrics /healthz /snapshot /flight)", file=sys.stderr)
+          "(/metrics /healthz /snapshot /flight /memory)",
+          file=sys.stderr)
     return srv

@@ -369,6 +369,35 @@ def reset() -> None:
     costmodel.reset()
 
 
+# -- memory accounting (ISSUE 12): ledger ring + learned model -------------
+#
+# Estimates: one ledger entry is a small dict of scalars (~400 B with
+# dict overhead), one Welford row a 5-float list keyed by a 4-tuple
+# (~250 B). Visible estimates beat invisible growth.
+
+_LEDGER_ENTRY_EST_BYTES = 400
+_MODEL_ROW_EST_BYTES = 250
+
+
+def _register_probe() -> None:
+    from . import memacct
+
+    def probe():
+        with _lock:
+            n_ledger = len(_ledger)
+        n_model = len(costmodel._stats) + len(costmodel._loaded)
+        return {
+            "bytes": float(n_ledger * _LEDGER_ENTRY_EST_BYTES
+                           + n_model * _MODEL_ROW_EST_BYTES),
+            "items": float(n_ledger + n_model),
+        }
+
+    memacct.register_probe("routing", probe)
+
+
+_register_probe()
+
+
 # ---------------------------------------------------------------------------
 # CLI renderers (telemetry route-report / what-if)
 # ---------------------------------------------------------------------------

@@ -98,8 +98,11 @@ _MAX_SPANS = 64  # root spans retained for snapshot(); older ones are counted
 # snapshot document version (ISSUE 6): consumers (report/prom/perfetto/
 # route-report CLIs, CI artifact tooling) can tell what shape they hold;
 # UNVERSIONED legacy snapshots keep rendering — the field is additive.
-# 1 = PR 1-5 shape (implicit); 2 = adds schema_version + pid + routing.
-SNAPSHOT_SCHEMA_VERSION = 2
+# 1 = PR 1-5 shape (implicit); 2 = adds schema_version + pid + routing;
+# 3 = adds gauges + the memory accounting section (ISSUE 12). Every
+# addition stays degradation-compatible both ways: older CLIs render v3
+# snapshots minus the new sections, this CLI renders v1/v2 untouched.
+SNAPSHOT_SCHEMA_VERSION = 3
 
 
 # flight recorder: compact records of the last N root spans, kept even
@@ -625,6 +628,24 @@ if knobs.get_raw("PYRUHVRO_TPU_OBS_PORT"):
     _obs_server.start_from_env()
 
 
+# memory accounting (ISSUE 12): the span/flight rings are themselves
+# long-lived state — account them like every other ring (per-record
+# size is an explicit estimate; the rings are bounded by construction)
+def _register_ring_probe() -> None:
+    from . import memacct
+
+    def probe():
+        with _lock:
+            n = len(_spans) + len(_flight)
+        return {"bytes": float(n * memacct.RING_RECORD_EST_BYTES),
+                "items": float(n)}
+
+    memacct.register_probe("rings", probe)
+
+
+_register_ring_probe()
+
+
 # ---------------------------------------------------------------------------
 # cross-process worker telemetry
 # ---------------------------------------------------------------------------
@@ -758,13 +779,14 @@ def reset() -> None:
         _roots_seen = 0
         _flight_last_auto = 0.0  # re-arm the auto-dump rate limiter
     _flight_dropped.reset()
-    from . import device_obs, drift, router, sampling
+    from . import device_obs, drift, memacct, router, sampling
 
     device_obs.reset()
     router.reset()
     sampling.reset()
     drift.reset()
     slo.reset()
+    memacct.reset()
     # NOT breaker/faults: breaker state is OPERATIONAL (an open breaker
     # must survive a snapshot reset — wiping it would silently re-admit
     # a broken seam) and the fault-injection counters are the chaos
@@ -840,6 +862,15 @@ def snapshot() -> Dict[str, Any]:
     brs = breaker.snapshot_breakers()
     if brs:
         out["breakers"] = brs
+    # memory accounting (ISSUE 12): always present on live snapshots —
+    # RSS exists even before any cache does. snapshot_memory() runs the
+    # probes, which also refreshes the mem.* gauges read just below.
+    from . import memacct
+
+    out["memory"] = memacct.snapshot_memory()
+    g = metrics.gauges()
+    if g:
+        out["gauges"] = g
     return out
 
 
@@ -864,6 +895,13 @@ def prometheus(snap: Optional[Dict[str, Any]] = None) -> str:
         name = _prom_name(key) + "_total"
         lines.append(f"# HELP {name} pyruhvro_tpu counter {key}")
         lines.append(f"# TYPE {name} counter")
+        lines.append(f"{name} {float(v)!r}")
+    # gauges (ISSUE 12): last-value facts — cache footprints, RSS —
+    # exported as `# TYPE ... gauge` with no `_total` suffix
+    for key, v in sorted(snap.get("gauges", {}).items()):
+        name = _prom_name(key)
+        lines.append(f"# HELP {name} pyruhvro_tpu gauge {key}")
+        lines.append(f"# TYPE {name} gauge")
         lines.append(f"{name} {float(v)!r}")
     for key, h in sorted(snap.get("histograms", {}).items()):
         name = _prom_name(key)
@@ -1278,6 +1316,17 @@ def render_report(data: Dict[str, Any]) -> str:
                 f"{(samp.get('overhead_frac') or 0) * 100:.2f}% per "
                 f"sampled call (budget "
                 f"{(samp.get('budget') or 0) * 100:.2f}% of total)")
+        mem = data.get("memory") or {}
+        if mem:
+            rss = mem.get("rss_bytes") or 0
+            tracked = mem.get("tracked_bytes") or 0
+            out += ["", "== memory =="]
+            line = (f"rss {_fmt_bytes(rss)}, tracked "
+                    f"{_fmt_bytes(tracked)} across "
+                    f"{len(mem.get('caches') or {})} cache(s)")
+            if rss:
+                line += f" ({tracked / rss * 100:.1f}% of rss)"
+            out.append(line + " — render with the mem-report subcommand")
         dr = data.get("drift") or {}
         if dr.get("entries"):
             hot = [e for e in dr["entries"] if e.get("detections")]
@@ -1315,9 +1364,11 @@ def main(argv: Optional[List[str]] = None) -> int:
     trace-event timeline) / ``route-report <file>`` (routing ledger +
     learned cost model) / ``what-if <file>`` (ledger replay: where a
     different arm would have won) / ``slo-report <file>`` (objectives,
-    burn rates, breach state) / ``serve <file> [--port N]`` (serve a
-    saved snapshot over HTTP). ``<file>`` is a saved :func:`snapshot`
-    JSON or, for ``report``, a ``BENCH_DETAILS.json``."""
+    burn rates, breach state) / ``mem-report <file>`` (memory
+    accounting: RSS vs tracked footprints, evictions, heavy hitters) /
+    ``serve <file> [--port N]`` (serve a saved snapshot over HTTP).
+    ``<file>`` is a saved :func:`snapshot` JSON or, for ``report``, a
+    ``BENCH_DETAILS.json``."""
     import argparse
 
     ap = argparse.ArgumentParser(
@@ -1350,6 +1401,11 @@ def main(argv: Optional[List[str]] = None) -> int:
         "slo-report", help="SLO objectives, burn rates and breach "
                            "state from a snapshot JSON")
     p_slo.add_argument("path")
+    p_mem = sub.add_parser(
+        "mem-report", help="memory accounting: RSS vs tracked cache "
+                           "footprints, eviction causes and per-tenant "
+                           "heavy hitters from a snapshot JSON")
+    p_mem.add_argument("path")
     p_serve = sub.add_parser(
         "serve", help="serve a SAVED snapshot over HTTP (/metrics "
                       "/healthz /snapshot) — point dashboards at a "
@@ -1419,6 +1475,14 @@ def main(argv: Optional[List[str]] = None) -> int:
                 "not a telemetry snapshot (expected 'slo'/'counters'/"
                 "'histograms' keys)")
         sys.stdout.write(slo.render_slo_report(data))
+    elif args.cmd == "mem-report":
+        if not ({"memory", "counters", "histograms"} & set(data)):
+            return _usage_error(
+                "not a telemetry snapshot (expected 'memory'/'counters'/"
+                "'histograms' keys)")
+        from . import memacct
+
+        sys.stdout.write(memacct.render_mem_report(data))
     elif args.cmd == "serve":
         if not ({"counters", "histograms", "spans"} & set(data)):
             return _usage_error(

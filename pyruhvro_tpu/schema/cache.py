@@ -2,11 +2,22 @@
 
 ≙ the reference's ``schema_cache``/``get_or_parse_schema``
 (``src/lib.rs:35-54``): a mutex-guarded map keyed by the *raw schema
-string*, unbounded by design — callers are expected to pass a small number
-of distinct schema strings over a process lifetime. We additionally hang
-the translated Arrow schema and (lazily) the compiled TPU field program
-off the same entry, which is the "schema → compiled kernel cache" the
-TPU design calls for (SURVEY.md §2, shared-schema amortization row).
+string*. The reference leaves it unbounded by design — callers are
+expected to pass a small number of distinct schema strings over a
+process lifetime. A serving replica is not that caller (ROADMAP item
+1: thousands of schemas), so since ISSUE 12 the cache is
+lifecycle-managed: every hit stamps ``last_used``, inserts run
+admission control (``PYRUHVRO_TPU_CACHE_MAX_SCHEMAS`` LRU cap), idle
+entries age out under ``PYRUHVRO_TPU_CACHE_TTL_S``, and memory
+pressure evicts in global LRU order (:mod:`..runtime.cachelife`).
+Eviction is correct by construction: everything an entry holds —
+parsed IR, Arrow schema, codecs in ``_extras`` — derives
+deterministically from the schema string, so a re-admitted schema
+rebuilds bit-identically (asserted by ``tests/test_memacct.py``
+against the differential oracles). We additionally hang the translated
+Arrow schema and (lazily) the compiled TPU field program off the same
+entry, which is the "schema → compiled kernel cache" the TPU design
+calls for (SURVEY.md §2, shared-schema amortization row).
 """
 
 from __future__ import annotations
@@ -17,7 +28,7 @@ from typing import Dict, Optional
 
 import pyarrow as pa
 
-from ..runtime import metrics, telemetry
+from ..runtime import cachelife, knobs, memacct, metrics, telemetry
 from .arrow_map import to_arrow_schema
 from .model import AvroType
 from .parser import parse_schema
@@ -28,7 +39,8 @@ __all__ = ["SchemaEntry", "get_or_parse_schema", "clear_schema_cache"]
 class SchemaEntry:
     """Everything derived from one schema string, computed once."""
 
-    __slots__ = ("schema_str", "ir", "_arrow", "_lock", "_extras", "_fp")
+    __slots__ = ("schema_str", "ir", "_arrow", "_lock", "_extras", "_fp",
+                 "last_used", "_fpb")
 
     def __init__(self, schema_str: str, ir: AvroType):
         self.schema_str = schema_str
@@ -39,6 +51,13 @@ class SchemaEntry:
         self._lock = threading.RLock()
         self._extras: Dict[str, object] = {}
         self._fp: Optional[str] = None
+        # LRU clock for the lifecycle manager: stamped lock-free on
+        # every cache hit (a float attr store is GIL-atomic)
+        self.last_used: float = time.monotonic()
+        # memoized footprint, invalidated when an extra lands: the
+        # admission path enumerates every entry per insert, so the
+        # walk over _extras must not re-run each time
+        self._fpb: Optional[int] = None
 
     @property
     def fingerprint(self) -> str:
@@ -70,7 +89,37 @@ class SchemaEntry:
         with self._lock:
             if key not in self._extras:
                 self._extras[key] = factory()
+                self._fpb = None  # footprint memo is stale now
             return self._extras[key]
+
+    def footprint_bytes(self) -> int:
+        """Approximate host bytes pinned by THIS entry: schema text +
+        parsed IR + Arrow schema (estimated as a multiple of the schema
+        text — IR size scales with it) plus the byte-accurate numpy
+        program tables of a built native codec. Engines, jit
+        executables and arenas are accounted by their own planes
+        (``cache.engines`` / ``cache.executables`` / ``cache.arenas``),
+        so the planes stay disjoint and the tracked total never double
+        counts. Memoized until the next ``get_extra`` insert — the
+        admission path reads it per entry per insert."""
+        fpb = self._fpb
+        if fpb is not None:
+            return fpb
+        n = len(self.schema_str) * 4 + 512
+        with self._lock:
+            extras = list(self._extras.items())
+        for key, val in extras:
+            n += 128  # dict slot + memo object overhead
+            prog = getattr(val, "prog", None)
+            for arr_name in ("ops", "coltypes"):
+                arr = getattr(prog, arr_name, None)
+                nbytes = getattr(arr, "nbytes", None)
+                if nbytes:
+                    n += int(nbytes)
+            if key in ("host_reader", "host_encode_plan"):
+                n += len(self.schema_str) * 2  # compiled-closure estimate
+        self._fpb = n
+        return n
 
 
 _cache: Dict[str, SchemaEntry] = {}
@@ -83,6 +132,7 @@ def get_or_parse_schema(schema_str: str) -> SchemaEntry:
     entry = _cache.get(schema_str)
     if entry is not None:
         metrics.inc("schema_cache.hits")
+        entry.last_used = time.monotonic()
         return entry
     metrics.inc("schema_cache.misses")
     t0 = time.perf_counter()
@@ -93,9 +143,53 @@ def get_or_parse_schema(schema_str: str) -> SchemaEntry:
         if entry is None:
             entry = SchemaEntry(schema_str, ir)
             _cache[schema_str] = entry
-        return entry
+    # admission control OUTSIDE the cache lock (eviction re-enters it)
+    entry.last_used = time.monotonic()
+    cachelife.admit("schema")
+    return entry
 
 
 def clear_schema_cache() -> None:
     with _cache_lock:
         _cache.clear()
+
+
+# -- lifecycle / accounting wiring (ISSUE 12) -------------------------------
+
+
+def _lifecycle_entries():
+    with _cache_lock:
+        entries = list(_cache.items())
+    return [(k, e.last_used, e.footprint_bytes()) for k, e in entries]
+
+
+def _evict(key: str) -> bool:
+    """Unlink one entry. In-flight calls hold their own reference and
+    finish on it; the next ``get_or_parse_schema`` re-parses (counted
+    as a miss) and rebuilds every derived object bit-identically."""
+    with _cache_lock:
+        gone = _cache.pop(key, None)
+    if gone is None:
+        return False
+    metrics.inc("schema_cache.evictions")
+    return True
+
+
+cachelife.register(
+    "schema",
+    entries=_lifecycle_entries,
+    evict=_evict,
+    capacity=lambda: knobs.get_int("PYRUHVRO_TPU_CACHE_MAX_SCHEMAS"),
+)
+
+
+def _probe():
+    with _cache_lock:
+        entries = list(_cache.values())
+    return {
+        "bytes": float(sum(e.footprint_bytes() for e in entries)),
+        "items": float(len(entries)),
+    }
+
+
+memacct.register_probe("cache.schema", _probe)

@@ -32,6 +32,7 @@ __all__ = [
     "random_value",
     "random_datums",
     "kafka_style_datums",
+    "synthetic_schema_variant",
     "KAFKA_SCHEMA_JSON",
     "CRITERION_SHAPES",
 ]
@@ -221,6 +222,26 @@ def kafka_style_datums(n: int, seed: int = 0) -> List[bytes]:
         writer(buf, rec)
         out.append(bytes(buf))
     return out
+
+def synthetic_schema_variant(i: int) -> str:
+    """Schema #i of the schema-churn population (ISSUE 12): thousands
+    of DISTINCT schema strings (distinct fingerprints, distinct cache
+    entries) that are individually cheap to parse, lower and decode —
+    the "millions of users means thousands of schemas" traffic shape
+    the cache-lifecycle soak (``scripts/mem_soak.py``) drives. Field
+    names vary with ``i`` so no two variants share a schema string."""
+    import json
+
+    return json.dumps({
+        "type": "record", "name": f"Churn{i}",
+        "fields": [
+            {"name": f"id_{i % 7}", "type": "long"},
+            {"name": f"label_{i % 5}", "type": "string"},
+            {"name": f"score_{i % 3}", "type": "double"},
+            {"name": "flag", "type": "boolean"},
+        ],
+    })
+
 
 # ---------------------------------------------------------------------------
 # Random schema generation (differential-fuzz harness)

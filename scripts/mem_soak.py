@@ -1,0 +1,262 @@
+#!/usr/bin/env python
+"""Bounded-memory soak: the ISSUE 12 acceptance evidence, reproducible.
+
+Two legs, one artifact (``MEM_REPORT.json``):
+
+1. **decompose** — the kafka 10k case decoded repeatedly: after a
+   warmup, steady-state RSS growth must be explained (>= 90%) by the
+   tracked cache footprints in ``snapshot()["memory"]`` — or be below
+   the noise floor entirely (nothing grows invisibly, which is the
+   property a serving replica actually needs). Both numbers are
+   reported raw.
+
+2. **churn** — ``--schemas`` (default 2000) distinct synthetic schemas
+   stream through the API around a hot ``--hot`` (default 64) schema
+   working set, with the schema-cache LRU cap and the RSS high-water
+   mark armed. Asserted: RSS stays under the high-water mark the whole
+   run (sampled per batch of schemas) and the hot set keeps a
+   >= 95% warm-hit rate — i.e. eviction holds memory flat WITHOUT
+   evicting the schemas that matter.
+
+``--gate`` exits non-zero when either leg misses its criterion (the CI
+``mem-soak`` job runs exactly that and uploads the report).
+
+Environment: the soak pins ``PYRUHVRO_TPU_SAMPLE_BUDGET=0`` (no
+background profiled-VM build mid-measurement) and
+``PYRUHVRO_TPU_NO_SPECIALIZE=1`` for the churn leg (64 hot schemas
+crossing the specialize threshold would queue 64 g++ runs — engine
+lifecycle is exercised by ``tests/test_memacct.py`` instead).
+"""
+
+from __future__ import annotations
+
+import argparse
+import gc
+import os
+import random
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+# measurement hygiene BEFORE the library imports (knobs are read at
+# call time, but the sampler arms itself from call one)
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["PYRUHVRO_TPU_SAMPLE_BUDGET"] = "0"
+
+NOISE_FLOOR_BYTES = 8 << 20  # RSS wobble below this is allocator noise
+
+
+def _mb(v: float) -> float:
+    return round(v / (1 << 20), 2)
+
+
+def leg_decompose(rows: int, calls: int) -> dict:
+    """Steady-state RSS growth vs tracked footprint on kafka <rows>."""
+    import pyruhvro_tpu as p
+    from pyruhvro_tpu.runtime import memacct
+    from pyruhvro_tpu.utils.datagen import (
+        KAFKA_SCHEMA_JSON,
+        kafka_style_datums,
+    )
+
+    data = kafka_style_datums(rows, seed=7)
+    # warmup: schema parse, native build/dlopen, specialization (rows
+    # accumulate past the threshold), allocator high-water settling
+    for _ in range(4):
+        p.deserialize_array(data, KAFKA_SCHEMA_JSON, backend="host")
+    gc.collect()
+    rss0 = memacct.rss_bytes()
+    tracked0 = memacct.tracked_bytes()
+    for _ in range(calls):
+        p.deserialize_array(data, KAFKA_SCHEMA_JSON, backend="host")
+    gc.collect()
+    rss1 = memacct.rss_bytes()
+    tracked1 = memacct.tracked_bytes()
+    rss_growth = rss1 - rss0
+    tracked_growth = tracked1 - tracked0
+    if rss_growth <= NOISE_FLOOR_BYTES:
+        ratio = 1.0
+        note = ("steady-state RSS growth below the noise floor: every "
+                "byte of growth is within allocator wobble, nothing "
+                "untracked is accumulating")
+    else:
+        ratio = max(0.0, tracked_growth) / rss_growth
+        note = "tracked cache growth over RSS growth"
+    return {
+        "rows": rows,
+        "calls": calls,
+        "rss_warm_mb": _mb(rss0),
+        "rss_end_mb": _mb(rss1),
+        "rss_growth_bytes": rss_growth,
+        "tracked_warm_bytes": tracked0,
+        "tracked_end_bytes": tracked1,
+        "tracked_growth_bytes": tracked_growth,
+        "noise_floor_bytes": NOISE_FLOOR_BYTES,
+        "decomposition": round(ratio, 4),
+        "decomposed_90pct": ratio >= 0.9,
+        "note": note,
+    }
+
+
+def leg_churn(schemas: int, hot: int, hot_rows: int, churn_rows: int,
+              high_water_mb: int, max_schemas: int) -> dict:
+    """2k-schema churn around a hot working set under the high-water
+    mark: RSS bounded, hot set warm."""
+    os.environ["PYRUHVRO_TPU_NO_SPECIALIZE"] = "1"
+    os.environ["PYRUHVRO_TPU_CACHE_MAX_SCHEMAS"] = str(max_schemas)
+    import pyruhvro_tpu as p
+    from pyruhvro_tpu.runtime import memacct, metrics
+    from pyruhvro_tpu.schema import cache as scache
+    from pyruhvro_tpu.utils.datagen import (
+        random_datums,
+        synthetic_schema_variant,
+    )
+    from pyruhvro_tpu.schema.parser import parse_schema
+
+    rng = random.Random(42)
+    hot_set = [synthetic_schema_variant(i) for i in range(hot)]
+    hot_data = {
+        s: random_datums(parse_schema(s), hot_rows, seed=i)
+        for i, s in enumerate(hot_set)
+    }
+    for s in hot_set:  # prewarm the working set
+        p.deserialize_array(hot_data[s], s, backend="host",
+                            tenant="hot-tenant")
+    gc.collect()
+    base_rss = memacct.rss_bytes()
+    high_water = base_rss + (high_water_mb << 20)
+    os.environ["PYRUHVRO_TPU_MEM_HIGH_WATER"] = str(high_water)
+    c0 = metrics.snapshot()
+    hot_calls = hot_hits = 0
+    max_rss = base_rss
+    t0 = time.perf_counter()
+    for i in range(hot, schemas):
+        s = synthetic_schema_variant(i)
+        data = random_datums(parse_schema(s), churn_rows, seed=i)
+        p.deserialize_array(data, s, backend="host",
+                            tenant=f"churn-{i % 8}")
+        # interleaved hot traffic: the LRU must keep these resident
+        hs = rng.choice(hot_set)
+        hot_calls += 1
+        if hs in scache._cache:
+            hot_hits += 1
+        p.deserialize_array(hot_data[hs], hs, backend="host",
+                            tenant="hot-tenant")
+        if i % 50 == 0:
+            gc.collect()
+            max_rss = max(max_rss, memacct.rss_bytes())
+    gc.collect()
+    max_rss = max(max_rss, memacct.rss_bytes())
+    elapsed = time.perf_counter() - t0
+    c1 = metrics.snapshot()
+
+    def delta(key: str) -> float:
+        return c1.get(key, 0.0) - c0.get(key, 0.0)
+
+    warm_hit_rate = hot_hits / hot_calls if hot_calls else 0.0
+    mem = memacct.snapshot_memory()
+    for k in ("PYRUHVRO_TPU_MEM_HIGH_WATER", "PYRUHVRO_TPU_NO_SPECIALIZE",
+              "PYRUHVRO_TPU_CACHE_MAX_SCHEMAS"):
+        os.environ.pop(k, None)
+    return {
+        "schemas": schemas,
+        "hot_set": hot,
+        "hot_rows": hot_rows,
+        "churn_rows": churn_rows,
+        "max_schemas_cap": max_schemas,
+        "elapsed_s": round(elapsed, 2),
+        "base_rss_mb": _mb(base_rss),
+        "high_water_mb_over_base": high_water_mb,
+        "high_water_bytes": high_water,
+        "max_rss_mb": _mb(max_rss),
+        "rss_under_high_water": max_rss <= high_water,
+        "warm_hit_rate": round(warm_hit_rate, 4),
+        "warm_hit_95pct": warm_hit_rate >= 0.95,
+        "live_schema_entries": len(scache._cache),
+        "evictions": {
+            "lru": delta("cache.evict.schema.lru"),
+            "ttl": delta("cache.evict.schema.ttl"),
+            "pressure": delta("cache.evict.schema.pressure"),
+        },
+        "pressure_events": delta("mem.pressure"),
+        "schema_cache": {
+            "hits": delta("schema_cache.hits"),
+            "misses": delta("schema_cache.misses"),
+            "evictions": delta("schema_cache.evictions"),
+        },
+        "memory_section": {
+            "tracked_bytes": mem["tracked_bytes"],
+            "caches": mem["caches"],
+            "top_tenants": (mem.get("tenants") or [])[:4],
+        },
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--schemas", type=int, default=2000)
+    ap.add_argument("--hot", type=int, default=64)
+    ap.add_argument("--hot-rows", type=int, default=64)
+    ap.add_argument("--churn-rows", type=int, default=32)
+    ap.add_argument("--high-water-mb", type=int, default=256,
+                    help="high-water mark ABOVE the post-prewarm "
+                         "baseline RSS")
+    ap.add_argument("--max-schemas", type=int, default=512,
+                    help="schema-cache LRU cap during the churn leg "
+                         "(sized so the hot working set survives the "
+                         "churn between its own touches: with cap C "
+                         "and hot H, a hot entry must be re-touched "
+                         "within C-H churn admissions)")
+    ap.add_argument("--decompose-rows", type=int, default=10_000)
+    ap.add_argument("--decompose-calls", type=int, default=40)
+    ap.add_argument("--skip-decompose", action="store_true")
+    ap.add_argument("--skip-churn", action="store_true")
+    ap.add_argument("--out", default="MEM_REPORT.json")
+    ap.add_argument("--gate", action="store_true",
+                    help="exit 1 when a leg misses its criterion")
+    args = ap.parse_args(argv)
+
+    from pyruhvro_tpu.runtime import fsio, memacct
+
+    report = {
+        "generated_by": "scripts/mem_soak.py",
+        "argv": sys.argv[1:],
+        "cpus": os.cpu_count(),
+        "baseline_rss_mb": _mb(memacct.rss_bytes()),
+    }
+    ok = True
+    if not args.skip_decompose:
+        leg = leg_decompose(args.decompose_rows, args.decompose_calls)
+        report["decompose"] = leg
+        ok = ok and leg["decomposed_90pct"]
+        print(f"[mem_soak] decompose: rss growth "
+              f"{leg['rss_growth_bytes']} B, tracked growth "
+              f"{leg['tracked_growth_bytes']} B -> "
+              f"{leg['decomposition']:.2%} "
+              f"({'OK' if leg['decomposed_90pct'] else 'FAIL'})")
+    if not args.skip_churn:
+        leg = leg_churn(args.schemas, args.hot, args.hot_rows,
+                        args.churn_rows, args.high_water_mb,
+                        args.max_schemas)
+        report["churn"] = leg
+        ok = ok and leg["rss_under_high_water"] and leg["warm_hit_95pct"]
+        print(f"[mem_soak] churn: {args.schemas} schemas in "
+              f"{leg['elapsed_s']}s, max rss {leg['max_rss_mb']} MB "
+              f"(high water base+{args.high_water_mb} MB: "
+              f"{'under' if leg['rss_under_high_water'] else 'OVER'}), "
+              f"warm-hit {leg['warm_hit_rate']:.2%} "
+              f"({'OK' if leg['warm_hit_95pct'] else 'FAIL'}), "
+              f"lru evictions {leg['evictions']['lru']:.0f}")
+    report["pass"] = ok
+    fsio.atomic_write_json(args.out, report, indent=1)
+    print(f"[mem_soak] report -> {args.out}")
+    if args.gate and not ok:
+        print("[mem_soak] GATE FAILED", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

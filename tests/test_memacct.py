@@ -1,0 +1,444 @@
+"""Memory accounting & cache lifecycle (ISSUE 12).
+
+Covers the two new runtime modules (memacct, cachelife) and their
+wiring: gauge export, byte-footprint probes for every cache plane,
+LRU/TTL/pressure eviction with per-cause counters, eviction→rebuild
+parity against the differential oracles for all four schema-keyed
+caches, per-(tenant, schema) heavy-hitter attribution, the mem-report
+CLI and the /memory obs-server endpoint.
+"""
+
+import json
+import time
+import urllib.request
+
+import pytest
+
+import pyruhvro_tpu as p
+from pyruhvro_tpu.runtime import (
+    cachelife,
+    device_obs,
+    memacct,
+    metrics,
+    obs_server,
+    telemetry,
+)
+from pyruhvro_tpu.schema import cache as scache
+from pyruhvro_tpu.schema.cache import clear_schema_cache
+from pyruhvro_tpu.utils.datagen import KAFKA_SCHEMA_JSON, kafka_style_datums
+
+
+@pytest.fixture(autouse=True)
+def _fresh_schema_cache():
+    clear_schema_cache()
+    yield
+    clear_schema_cache()
+
+
+def _schema(i: int) -> str:
+    return json.dumps({
+        "type": "record", "name": f"Mem{i}",
+        "fields": [{"name": "a", "type": "long"},
+                   {"name": "b", "type": "string"}],
+    })
+
+
+# ---------------------------------------------------------------------------
+# gauges (satellite: first-class gauge support)
+# ---------------------------------------------------------------------------
+
+
+def test_set_gauge_roundtrip_and_reset():
+    metrics.set_gauge("test.gauge", 42.5)
+    assert metrics.gauges()["test.gauge"] == 42.5
+    metrics.set_gauge("test.gauge", 7.0)  # last value wins, not a sum
+    assert metrics.gauges()["test.gauge"] == 7.0
+    metrics.reset()
+    assert "test.gauge" not in metrics.gauges()
+
+
+def test_snapshot_carries_gauges_and_memory_section():
+    data = kafka_style_datums(50, seed=3)
+    p.deserialize_array(data, KAFKA_SCHEMA_JSON, backend="host")
+    snap = telemetry.snapshot()
+    assert snap["schema_version"] == telemetry.SNAPSHOT_SCHEMA_VERSION
+    mem = snap["memory"]
+    assert mem["rss_bytes"] > 0
+    assert mem["tracked_bytes"] > 0
+    assert mem["caches"]["cache.schema"]["items"] >= 1
+    g = snap["gauges"]
+    assert g["mem.rss_bytes"] == mem["rss_bytes"]
+    assert g["mem.cache.schema.bytes"] > 0
+
+
+def test_prometheus_exports_gauges_typed():
+    metrics.set_gauge("mem.test_plane.bytes", 1234.0)
+    snap = {"counters": {"x.calls": 1.0},
+            "gauges": metrics.gauges(), "histograms": {}}
+    text = telemetry.prometheus(snap)
+    assert "# TYPE pyruhvro_tpu_mem_test_plane_bytes gauge" in text
+    assert "pyruhvro_tpu_mem_test_plane_bytes 1234.0" in text
+    # gauges never get the _total suffix; counters keep it
+    assert "pyruhvro_tpu_mem_test_plane_bytes_total" not in text
+    assert "pyruhvro_tpu_x_calls_total 1.0" in text
+
+
+def test_legacy_snapshot_without_gauges_renders_unchanged():
+    # a v2 snapshot has no gauges/memory keys: prom/report must not care
+    snap = {"schema_version": 2, "counters": {"a.b": 1.0},
+            "histograms": {}, "spans": []}
+    assert "gauge" not in telemetry.prometheus(snap)
+    assert telemetry.render_report(snap)
+    assert "predates" in memacct.render_mem_report(snap)
+
+
+# ---------------------------------------------------------------------------
+# schema cache: LRU admission + TTL + rebuild parity
+# ---------------------------------------------------------------------------
+
+
+def test_schema_lru_admission_cap(monkeypatch):
+    monkeypatch.setenv("PYRUHVRO_TPU_CACHE_MAX_SCHEMAS", "4")
+    for i in range(9):
+        scache.get_or_parse_schema(_schema(i))
+    assert len(scache._cache) == 4
+    c = metrics.snapshot()
+    assert c["cache.evict.schema.lru"] == 5
+    assert c["schema_cache.evictions"] == 5
+    # the survivors are the most recently used
+    live = {json.loads(k)["name"] for k in scache._cache}
+    assert live == {"Mem5", "Mem6", "Mem7", "Mem8"}
+
+
+def test_schema_lru_evicts_least_recently_used(monkeypatch):
+    monkeypatch.setenv("PYRUHVRO_TPU_CACHE_MAX_SCHEMAS", "2")
+    scache.get_or_parse_schema(_schema(0))
+    scache.get_or_parse_schema(_schema(1))
+    scache.get_or_parse_schema(_schema(0))  # refresh 0's clock
+    scache.get_or_parse_schema(_schema(2))  # must evict 1, not 0
+    live = {json.loads(k)["name"] for k in scache._cache}
+    assert live == {"Mem0", "Mem2"}
+
+
+def test_schema_ttl_eviction(monkeypatch):
+    monkeypatch.setenv("PYRUHVRO_TPU_CACHE_TTL_S", "0.01")
+    scache.get_or_parse_schema(_schema(0))
+    # a fresh entry survives the sweep (other planes may carry stale
+    # entries from earlier tests — assert on the schema plane only)
+    cachelife.sweep(time.monotonic())
+    assert len(scache._cache) == 1
+    time.sleep(0.03)
+    cachelife.sweep(time.monotonic())
+    assert len(scache._cache) == 0
+    assert metrics.snapshot()["cache.evict.schema.ttl"] >= 1
+
+
+def test_ttl_off_by_default():
+    scache.get_or_parse_schema(_schema(0))
+    time.sleep(0.01)
+    assert cachelife.sweep(time.monotonic()) == 0
+    assert len(scache._cache) == 1
+
+
+def test_schema_eviction_rebuild_bit_identical(monkeypatch):
+    data = kafka_style_datums(200, seed=4)
+    before = p.deserialize_array(data, KAFKA_SCHEMA_JSON, backend="host")
+    misses0 = metrics.snapshot()["schema_cache.misses"]
+    # evict everything, then decode again: the re-parsed entry and its
+    # rebuilt codecs must produce a bit-identical batch
+    for key in list(scache._cache):
+        scache._evict(key)
+    after = p.deserialize_array(data, KAFKA_SCHEMA_JSON, backend="host")
+    assert before.equals(after)
+    assert metrics.snapshot()["schema_cache.misses"] == misses0 + 1
+
+
+def test_hit_miss_evict_counters_reconcile(monkeypatch):
+    monkeypatch.setenv("PYRUHVRO_TPU_CACHE_MAX_SCHEMAS", "3")
+    calls = 0
+    for i in range(6):
+        for _ in range(2):
+            scache.get_or_parse_schema(_schema(i))
+            calls += 1
+    c = metrics.snapshot()
+    hits = c.get("schema_cache.hits", 0)
+    misses = c.get("schema_cache.misses", 0)
+    evictions = c.get("schema_cache.evictions", 0)
+    assert hits + misses == calls
+    # live entries = admissions - evictions
+    assert len(scache._cache) == misses - evictions
+    assert evictions == c.get("cache.evict.schema.lru")
+
+
+# ---------------------------------------------------------------------------
+# memory pressure
+# ---------------------------------------------------------------------------
+
+
+def test_pressure_eviction_and_health_bit(monkeypatch):
+    scache.get_or_parse_schema(_schema(0))
+    scache.get_or_parse_schema(_schema(1))
+    monkeypatch.setenv("PYRUHVRO_TPU_MEM_HIGH_WATER", "1")  # always over
+    memacct.force_pressure_check()
+    c = metrics.snapshot()
+    assert c["mem.pressure"] >= 1
+    assert c["cache.evict.schema.pressure"] >= 1
+    assert metrics.mark_age("mem_pressure") is not None
+    # the live health endpoint reports the bit as unhealthy
+    code, body = obs_server.health()
+    assert code == 503
+    assert body["unhealthy_bits"]["mem_pressure"] is True
+
+
+def test_no_pressure_without_high_water():
+    scache.get_or_parse_schema(_schema(0))
+    memacct.force_pressure_check()
+    c = metrics.snapshot()
+    assert "mem.pressure" not in c
+    assert len(scache._cache) == 1
+
+
+def test_pressure_annotates_snapshot_state(monkeypatch):
+    monkeypatch.setenv("PYRUHVRO_TPU_MEM_HIGH_WATER", "1")
+    snap = memacct.snapshot_memory()
+    assert snap["high_water_bytes"] == 1
+    assert snap["over_high_water"] is True
+
+
+# ---------------------------------------------------------------------------
+# specialized engines: evict -> re-admit (dlopen) -> parity
+# ---------------------------------------------------------------------------
+
+
+def test_engine_eviction_rebuild_parity(monkeypatch):
+    monkeypatch.setenv("PYRUHVRO_TPU_SPECIALIZE_ROWS", "0")
+    from pyruhvro_tpu.hostpath import specialize
+
+    data = kafka_style_datums(150, seed=5)
+    before = p.deserialize_array(data, KAFKA_SCHEMA_JSON, backend="host")
+    names = [n for n, _, _ in specialize._engine_entries()]
+    if not names:
+        pytest.skip("no toolchain: specialization unavailable")
+    mem = memacct.snapshot_memory()
+    eng = mem["caches"]["cache.engines"]
+    assert eng["items"] >= 1 and eng["bytes"] > 0  # .so file sizes
+    for n in names:
+        assert specialize._evict_engine(n)
+    assert not specialize._engine_entries()
+    after = p.deserialize_array(data, KAFKA_SCHEMA_JSON, backend="host")
+    assert before.equals(after)
+    # the engine re-admitted from the disk build cache
+    assert specialize._engine_entries()
+    assert metrics.snapshot()["specialize.evictions"] >= 1
+
+
+# ---------------------------------------------------------------------------
+# device tier: executables + arenas
+# ---------------------------------------------------------------------------
+
+
+def test_executable_eviction_recompiles_and_matches():
+    data = kafka_style_datums(120, seed=6)
+    before = p.deserialize_array(data, KAFKA_SCHEMA_JSON, backend="tpu")
+    mem = memacct.snapshot_memory()
+    assert mem["caches"]["cache.executables"]["items"] >= 1
+    assert mem["caches"]["cache.arenas"]["bytes"] > 0
+    misses0 = metrics.snapshot()["device.jit_cache.misses"]
+    for key, _ts, _b in device_obs._exe_entries():
+        assert device_obs._evict_executable(key)
+    assert not device_obs._exe_entries()
+    after = p.deserialize_array(data, KAFKA_SCHEMA_JSON, backend="tpu")
+    assert before.equals(after)
+    # eviction really dropped the executable: the rebuild is a fresh
+    # cache miss (misses == actual compiles is the PR 5 contract)
+    assert metrics.snapshot()["device.jit_cache.misses"] > misses0
+    assert metrics.snapshot()["device.jit_cache.evictions"] >= 1
+
+
+def test_arena_eviction_rebuild_parity():
+    data = kafka_style_datums(120, seed=7)
+    before = p.deserialize_array(data, KAFKA_SCHEMA_JSON, backend="tpu")
+    ents = device_obs._arena_entries()
+    assert ents
+    for key, _ts, _b in ents:
+        assert device_obs._evict_arena(key)
+    assert not device_obs._arena_entries()
+    misses0 = metrics.snapshot()["device.arena.misses"]
+    after = p.deserialize_array(data, KAFKA_SCHEMA_JSON, backend="tpu")
+    assert before.equals(after)
+    assert metrics.snapshot()["device.arena.misses"] > misses0
+    assert metrics.snapshot()["device.arena.evictions"] >= 1
+
+
+def test_executable_registry_tracks_bytes_and_lru():
+    data = kafka_style_datums(80, seed=8)
+    p.deserialize_array(data, KAFKA_SCHEMA_JSON, backend="tpu")
+    ents = device_obs._exe_entries()
+    assert ents
+    for _key, ts, b in ents:
+        assert ts > 0
+        assert b > 0  # memory_analysis or the documented estimate
+
+
+# ---------------------------------------------------------------------------
+# per-(tenant, schema) attribution
+# ---------------------------------------------------------------------------
+
+
+def test_tenant_attribution_lands_in_sketch_and_span():
+    data = kafka_style_datums(40, seed=9)
+    p.deserialize_array(data, KAFKA_SCHEMA_JSON, backend="host",
+                        tenant="acme")
+    p.deserialize_array(data, KAFKA_SCHEMA_JSON, backend="host",
+                        tenant="acme")
+    p.deserialize_array(data, KAFKA_SCHEMA_JSON, backend="host")
+    snap = telemetry.snapshot()
+    rows = {(r["tenant"], r["schema"]): r
+            for r in snap["memory"]["tenants"]}
+    fp = scache.get_or_parse_schema(KAFKA_SCHEMA_JSON).fingerprint
+    assert rows[("acme", fp)]["calls"] == 2
+    assert rows[("acme", fp)]["rows"] == 80
+    assert rows[("acme", fp)]["bytes"] > 0
+    assert rows[("-", fp)]["calls"] == 1  # untagged pool
+    # the root span carries the tenant attr
+    spans = [s for s in snap["spans"]
+             if s["attrs"].get("tenant") == "acme"]
+    assert spans
+
+
+def test_tenant_kwarg_on_every_api_function():
+    import pyarrow as pa
+
+    data = kafka_style_datums(20, seed=10)
+    p.deserialize_array_threaded(data, KAFKA_SCHEMA_JSON, 2,
+                                 backend="host", tenant="t1")
+    p.deserialize_array_threaded_spawn(data, KAFKA_SCHEMA_JSON, 2,
+                                       backend="host", tenant="t1")
+    batch = p.deserialize_array(data, KAFKA_SCHEMA_JSON, backend="host")
+    p.serialize_record_batch(batch, KAFKA_SCHEMA_JSON, 1,
+                             backend="host", tenant="t1")
+    p.serialize_record_batch_spawn(batch, KAFKA_SCHEMA_JSON, 1,
+                                   backend="host", tenant="t1")
+    rows = {r["tenant"]: r for r in memacct.snapshot_memory()["tenants"]}
+    assert rows["t1"]["calls"] == 4
+    assert rows["t1"]["decode_calls"] == 2
+    assert rows["t1"]["encode_calls"] == 2
+
+
+def test_sketch_is_bounded_topk(monkeypatch):
+    monkeypatch.setenv("PYRUHVRO_TPU_MEM_TOPK", "4")
+    for i in range(12):
+        memacct.attribute(f"tenant{i}", "fp", "decode", 10, [b"x" * 8])
+    # the heavy tenant keeps accumulating through replacements
+    for _ in range(5):
+        memacct.attribute("whale", "fp", "decode", 1000, [b"x" * 4096])
+    rows = memacct._sketch.snapshot()
+    assert len(rows) <= 4
+    assert rows[0]["tenant"] == "whale"  # sorted by bytes, whale on top
+
+
+# ---------------------------------------------------------------------------
+# mem-report CLI + /memory endpoint
+# ---------------------------------------------------------------------------
+
+
+def test_mem_report_cli_renders_snapshot(tmp_path, capsys):
+    data = kafka_style_datums(60, seed=11)
+    p.deserialize_array(data, KAFKA_SCHEMA_JSON, backend="host",
+                        tenant="cli-tenant")
+    path = tmp_path / "snap.json"
+    path.write_text(json.dumps(telemetry.snapshot(), default=str))
+    rc = telemetry.main(["mem-report", str(path)])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "== memory ==" in out
+    assert "cache.schema" in out
+    assert "cli-tenant" in out
+
+
+def test_mem_report_cli_exit2_contract(tmp_path, capsys):
+    assert telemetry.main(["mem-report", str(tmp_path / "nope.json")]) == 2
+    bad = tmp_path / "bad.json"
+    bad.write_text("[1, 2]")
+    assert telemetry.main(["mem-report", str(bad)]) == 2
+    notsnap = tmp_path / "notsnap.json"
+    notsnap.write_text("{\"foo\": 1}")
+    assert telemetry.main(["mem-report", str(notsnap)]) == 2
+    capsys.readouterr()
+
+
+def test_memory_endpoint_live():
+    data = kafka_style_datums(30, seed=12)
+    p.deserialize_array(data, KAFKA_SCHEMA_JSON, backend="host")
+    srv = obs_server.ObsServer(port=0).start()
+    try:
+        with urllib.request.urlopen(f"{srv.url}/memory", timeout=5) as r:
+            doc = json.loads(r.read())
+        assert doc["rss_bytes"] > 0
+        assert "cache.schema" in doc["caches"]
+        # 404 listing names the new endpoint
+        try:
+            urllib.request.urlopen(f"{srv.url}/nope", timeout=5)
+        except urllib.error.HTTPError as e:
+            assert "/memory" in json.loads(e.read())["endpoints"]
+    finally:
+        srv.stop()
+
+
+def test_memory_endpoint_static_snapshot(tmp_path):
+    data = kafka_style_datums(30, seed=13)
+    p.deserialize_array(data, KAFKA_SCHEMA_JSON, backend="host")
+    snap = json.loads(json.dumps(telemetry.snapshot(), default=str))
+    srv = obs_server.ObsServer(port=0, snapshot=snap).start()
+    try:
+        with urllib.request.urlopen(f"{srv.url}/memory", timeout=5) as r:
+            doc = json.loads(r.read())
+        assert doc["rss_bytes"] == snap["memory"]["rss_bytes"]
+    finally:
+        srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# accounting internals
+# ---------------------------------------------------------------------------
+
+
+def test_rss_probe_reads_statm():
+    rss = memacct.rss_bytes()
+    assert rss > 10 * 1024 * 1024  # a jax-importing process is > 10 MB
+    assert memacct.peak_rss_bytes() >= rss // 2
+
+
+def test_probe_errors_are_counted_not_raised():
+    memacct.register_probe("test.broken", lambda: 1 / 0)
+    try:
+        out = memacct.collect()
+        assert "test.broken" not in out
+        assert metrics.snapshot()["mem.probe_error"] >= 1
+    finally:
+        with memacct._lock:
+            memacct._probes.pop("test.broken", None)
+
+
+def test_relieve_frees_requested_overage(monkeypatch):
+    for i in range(6):
+        scache.get_or_parse_schema(_schema(i))
+    ents = scache._lifecycle_entries()
+    per_entry = ents[0][2]
+    overage = per_entry + 1
+    evicted, freed = cachelife.relieve(overage)
+    # relieve stops as soon as the freed bytes cover the overage (other
+    # planes may contribute older entries first, so assert the
+    # contract, not a specific victim count)
+    assert evicted >= 1
+    assert freed >= overage
+    assert len(scache._cache) >= 4
+
+
+def test_footprint_scales_with_built_codecs():
+    entry = scache.get_or_parse_schema(KAFKA_SCHEMA_JSON)
+    bare = entry.footprint_bytes()
+    p.deserialize_array(kafka_style_datums(30, seed=14),
+                        KAFKA_SCHEMA_JSON, backend="host")
+    built = entry.footprint_bytes()
+    assert built > bare  # the native codec's numpy tables are counted

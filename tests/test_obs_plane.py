@@ -639,7 +639,7 @@ def _v2_snapshot_with_new_sections(tmp_path):
         sampling.set_enabled(None)
         os.environ.pop("PYRUHVRO_TPU_SLO_FILE", None)
         slo.reset()
-    assert snap["schema_version"] == 2
+    assert snap["schema_version"] == telemetry.SNAPSHOT_SCHEMA_VERSION
     assert "slo" in snap and "sampling" in snap and "drift" in snap
     path = tmp_path / "snap_v2.json"
     path.write_text(json.dumps(snap, default=str))

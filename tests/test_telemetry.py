@@ -229,7 +229,8 @@ def test_prometheus_export_parses_line_by_line():
             continue
         if line.startswith("# TYPE "):
             assert re.match(
-                r"^# TYPE [a-zA-Z_][a-zA-Z0-9_]* (counter|histogram)$", line
+                r"^# TYPE [a-zA-Z_][a-zA-Z0-9_]* (counter|histogram|gauge)$",
+                line
             ), line
             continue
         m = _PROM_LINE.match(line)
