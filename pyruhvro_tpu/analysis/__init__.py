@@ -1,6 +1,6 @@
-"""Repo-native static-analysis plane (ISSUE 11).
+"""Repo-native static-analysis plane (ISSUE 11 + 14).
 
-Three coupled passes, run as one CI gate (``scripts/analysis_gate.py``):
+Four coupled passes, run as one CI gate (``scripts/analysis_gate.py``):
 
 1. :mod:`.contracts` — the cross-language opcode contract checker. The
    fused decode path mirrors one contract in four hand-synchronized
@@ -15,8 +15,15 @@ Three coupled passes, run as one CI gate (``scripts/analysis_gate.py``):
    reachable from a registered signal handler, no whole-file
    ``json.dump`` outside ``runtime/fsio.py``, and no swallowed
    ``FaultInjected`` without a counted metric.
-3. sanitizer builds — ``runtime/native/build.py``'s ASan/UBSan flavor,
-   exercised by the gate's ``--sanitize`` mode and the CI job.
+3. :mod:`.concurrency` — the concurrency-correctness pass (ISSUE 14):
+   lock-order inversion cycles over the acquired-while-held graph,
+   locks held across blocking seams, and the ``# guarded-by:`` /
+   ``# lock-free-ok(...)`` discipline for ``runtime/`` module globals,
+   with an audited waiver list exported to the report.
+4. sanitizer builds — ``runtime/native/build.py``'s ASan/UBSan flavor
+   (gate ``--sanitize``) and ThreadSanitizer flavor (``--tsan``, the
+   dynamic complement of the lock-graph pass), each with its own CI
+   job.
 
 Every pass reports plain :class:`Finding` rows; the gate exits non-zero
 on any finding and writes ``ANALYSIS_REPORT.json``.

@@ -8,7 +8,9 @@ reviewer memory:
   through the typed registry (parse-with-fallback, documented,
   inventoried).
 * ``lint.signal-safety`` — no ``metrics.inc``/``merge``/``mark``,
-  ``faults.fire``, blocking ``.acquire()`` or ``with <lock>:`` in code
+  ``faults.fire``, ``schedtest.yield_point``/``yp`` (ISSUE 14: a
+  yield-point under an active harness parks the thread on a condition
+  variable), blocking ``.acquire()`` or ``with <lock>:`` in code
   reachable (same-module call graph) from a function registered via
   ``signal.signal``: the handler may have interrupted the very frame
   that holds the non-reentrant lock. Counters bumped from signal
@@ -53,10 +55,14 @@ _SIGNAL_WAIVER = "# signal-ok"
 
 # calls that may take the non-reentrant metrics/telemetry locks —
 # forbidden in signal-reachable code (DeferredCount.bump is the
-# sanctioned counter there)
+# sanctioned counter there). schedtest yield-points (ISSUE 14) park
+# the calling thread on a condition variable under an active harness,
+# and faults.fire can sleep at a seam — a handler that reaches either
+# can wedge the very frame it interrupted.
 _UNSAFE_MODULE_CALLS = {
     ("metrics", "inc"), ("metrics", "merge"), ("metrics", "mark"),
     ("faults", "fire"),
+    ("schedtest", "yield_point"), ("schedtest", "yp"),
 }
 
 

@@ -35,6 +35,7 @@ from typing import Dict, List
 
 import numpy as np
 
+from ..runtime import schedtest
 from .program import HostProgram
 
 __all__ = ["generate_source", "load_specialized", "touch_engine",
@@ -770,7 +771,7 @@ def _native_dir() -> str:
 
 _eng_lock = threading.Lock()
 # mod_name -> {"bytes": so size, "last_used": monotonic, "codecs": WeakSet}
-_engines: Dict[str, dict] = {}
+_engines: Dict[str, dict] = {}  # guarded-by: _eng_lock
 
 
 def _note_engine(mod_name: str, so_path: str) -> dict:
@@ -778,6 +779,7 @@ def _note_engine(mod_name: str, so_path: str) -> dict:
         size = os.path.getsize(so_path)
     except OSError:
         size = 0
+    schedtest.yp("engine.note")
     with _eng_lock:
         rec = _engines.get(mod_name)
         if rec is None:
@@ -820,6 +822,7 @@ def _evict_engine(mod_name: str) -> bool:
     from ..runtime import metrics
     from ..runtime.native import build as nb
 
+    schedtest.yp("engine.evict")
     with _eng_lock:
         rec = _engines.pop(mod_name, None)
     if rec is None:
@@ -871,10 +874,11 @@ def load_specialized(prog: HostProgram):
     """
     from ..runtime.native import build as nb
 
-    if nb._san_active():
+    if nb._san_active() or nb._tsan_active():
         # the spec cache is keyed by source content only — a sanitized
         # build would be served to later uninstrumented runs. Sanitizer
-        # sessions pin the interpreter VM (whose .san flavor IS keyed).
+        # sessions (ASan and TSan alike) pin the interpreter VM (whose
+        # .san/.tsan flavors ARE keyed).
         return None
     spec_dir = os.path.join(_native_dir(), "_spec")
     try:
@@ -897,6 +901,7 @@ def load_specialized(prog: HostProgram):
         if mod is not None:
             _note_engine(mod_name, so)
             return mod
+        schedtest.yp("engine.memo")
         with nb._lock:
             mod = nb._modules.get(mod_name)
             if mod is not None:

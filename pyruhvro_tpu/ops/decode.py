@@ -39,6 +39,7 @@ from ..runtime import (
     device_obs,
     faults,
     metrics,
+    schedtest,
     telemetry,
 )
 from ..runtime.pack import bucket_len, concat_records
@@ -344,6 +345,7 @@ class DeviceDecoder:
         race-free by allocating per call; per-thread arenas restore
         that invariant at per-thread cost)."""
         key = (R, B, slot, threading.get_ident())
+        schedtest.yp("arena.checkout")
         with self._lock:
             buf = self._arenas.get(key)
             if buf is None:

@@ -44,7 +44,7 @@ import threading
 import time
 from typing import Any, Dict, Optional
 
-from . import knobs, metrics
+from . import knobs, metrics, schedtest
 
 __all__ = [
     "CircuitBreaker",
@@ -77,7 +77,8 @@ class CircuitBreaker:
     transition, so tests can flip them in-process)."""
 
     __slots__ = ("name", "_threshold", "_backoff_s", "_lock", "_failures",
-                 "_opens", "_state", "_open_until", "_probe_at")
+                 "_opens", "_state", "_open_until", "_probe_at",
+                 "_probe_owner")
 
     def __init__(self, name: str, threshold: int = 3,
                  backoff_s: float = 1.0):
@@ -90,6 +91,12 @@ class CircuitBreaker:
         self._state = "closed"
         self._open_until = 0.0
         self._probe_at: Optional[float] = None  # half-open probe start
+        # thread ident of the probe holder (ISSUE 14): release() is a
+        # no-verdict exit and must only clear the slot for the thread
+        # that ACQUIRED it — a stale release (TTL-forfeited probe whose
+        # slot a second caller re-acquired) would otherwise free the
+        # live probe's slot and admit two concurrent probes
+        self._probe_owner: Optional[int] = None
 
     # -- knobs --------------------------------------------------------------
 
@@ -111,10 +118,14 @@ class CircuitBreaker:
         if self._state == "open" and now >= self._open_until:
             self._state = "half_open"
             self._probe_at = None
+            self._probe_owner = None
             metrics.inc(f"breaker.{self.name}.half_open")
         if (self._state == "half_open" and self._probe_at is not None
                 and now - self._probe_at > _PROBE_TTL_S):
-            self._probe_at = None  # forfeited probe: allow another
+            # forfeited probe: allow another (the forfeiter's eventual
+            # release() is a no-op — it no longer owns the slot)
+            self._probe_at = None
+            self._probe_owner = None
         return self._state
 
     def state(self) -> str:
@@ -132,25 +143,38 @@ class CircuitBreaker:
         MUST end with :meth:`record_success` or :meth:`record_failure`);
         concurrent callers are refused until the probe reports (or its
         TTL lapses)."""
+        schedtest.yp("breaker.acquire")
         with self._lock:
-            st = self._state_locked(time.monotonic())
+            now = time.monotonic()
+            st = self._state_locked(now)
             if st == "closed":
                 return True
             if st == "open":
                 return False
             if self._probe_at is not None:
                 return False
-            self._probe_at = time.monotonic()
+            self._probe_at = now
+            self._probe_owner = threading.get_ident()
             metrics.inc(f"breaker.{self.name}.probe")
             return True
 
     def record_success(self) -> None:
         """A call through the seam succeeded: reset failures; a
         half-open probe success closes the breaker for good (the
-        backoff exponent resets too)."""
+        backoff exponent resets too).
+
+        Deliberately NOT owner-checked (unlike :meth:`release`): a
+        verdict is evidence about the SEAM, whoever carries it — a
+        TTL-forfeited probe whose call eventually succeeded still
+        proves the seam works, so it closes; its failure still proves
+        the seam broken, so it opens. Ownership only gates the
+        no-verdict exit, where a stale release would free a live
+        probe's slot without any evidence at all."""
+        schedtest.yp("breaker.record")
         with self._lock:
             self._failures = 0
             self._probe_at = None
+            self._probe_owner = None
             if self._state != "closed":
                 self._state = "closed"
                 self._opens = 0
@@ -160,11 +184,13 @@ class CircuitBreaker:
         """A call through the seam failed. In half-open (failed probe)
         or past the threshold in closed: open with exponential backoff.
         """
+        schedtest.yp("breaker.record")
         with self._lock:
             now = time.monotonic()
             st = self._state_locked(now)
             self._failures += 1
             self._probe_at = None
+            self._probe_owner = None
             if st == "half_open" or (st == "closed"
                                      and self._failures >= self.threshold()):
                 self._opens += 1
@@ -179,9 +205,18 @@ class CircuitBreaker:
         seam (e.g. a data/contract error raised before the probed work
         could succeed or fail). Without this, a raising exit between
         :meth:`acquire` and a ``record_*`` call would wedge the
-        half-open slot for the probe TTL."""
+        half-open slot for the probe TTL.
+
+        Owner-checked: only the thread that acquired the CURRENT probe
+        slot can return it. A stale release — this thread's probe was
+        TTL-forfeited and the slot re-acquired by someone else — is a
+        no-op, so it can never free a live probe and admit a second
+        concurrent one (ISSUE 14)."""
+        schedtest.yp("breaker.release")
         with self._lock:
-            self._probe_at = None
+            if self._probe_owner == threading.get_ident():
+                self._probe_at = None
+                self._probe_owner = None
 
     def force_open(self, backoff_s: Optional[float] = None) -> None:
         """Open immediately (tests / operator escape hatch)."""
@@ -192,6 +227,7 @@ class CircuitBreaker:
                 self._next_backoff_s() if backoff_s is None
                 else max(0.0, backoff_s))
             self._probe_at = None
+            self._probe_owner = None
             metrics.inc(f"breaker.{self.name}.opened")
             metrics.mark("breaker_open")
 
@@ -214,7 +250,7 @@ class CircuitBreaker:
 
 
 _lock = threading.Lock()
-_registry: Dict[str, CircuitBreaker] = {}
+_registry: Dict[str, CircuitBreaker] = {}  # guarded-by: _lock
 
 # per-seam defaults: the spawn pool and the device backend open on the
 # FIRST failure (a broken pool / wedged transport is heavyweight to

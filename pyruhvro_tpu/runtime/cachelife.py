@@ -45,7 +45,7 @@ from __future__ import annotations
 import threading
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
-from . import knobs, metrics
+from . import knobs, metrics, schedtest
 
 __all__ = [
     "register",
@@ -70,7 +70,7 @@ class _Managed:
 
 
 _lock = threading.Lock()
-_caches: Dict[str, _Managed] = {}
+_caches: Dict[str, _Managed] = {}  # guarded-by: _lock
 
 
 def register(name: str, *, entries: Callable[[], List[tuple]],
@@ -94,6 +94,7 @@ def _safe_entries(c: _Managed) -> List[tuple]:
 
 
 def _evict_one(c: _Managed, key, cause: str) -> bool:
+    schedtest.yp("cachelife.evict")
     try:
         ok = bool(c.evict(key))
     except Exception:

@@ -54,7 +54,7 @@ _lock = threading.Lock()
 # (schema fingerprint, R bucket) -> plan:
 #   {"item_caps": {path: int}, "tot_caps": {path: int},
 #    "str_full_B": set[int]}
-_plans: Dict[Tuple[str, int], Dict[str, Any]] = {}
+_plans: Dict[Tuple[str, int], Dict[str, Any]] = {}  # guarded-by: _lock
 
 
 def persist_enabled() -> bool:

@@ -37,7 +37,7 @@ import threading
 import time
 from typing import Any, Dict, List, Optional, Tuple
 
-from . import knobs, metrics
+from . import knobs, metrics, schedtest
 
 __all__ = [
     "PROFILE_VERSION",
@@ -80,24 +80,24 @@ _N_CAP = 256.0
 
 _lock = threading.Lock()
 # (schema_fp, op, band, arm) -> [n, mean_s_per_row, m2]
-_stats: Dict[Tuple[str, str, int, str], List[float]] = {}
+_stats: Dict[Tuple[str, str, int, str], List[float]] = {}  # guarded-by: _lock
 # per-key baseline of evidence that came FROM DISK (load_profile or a
 # previous save's rebase): save_profile subtracts it so each save
 # contributes only THIS process's own observations — without it, every
 # load+save cycle would Welford-merge the same historical evidence
 # twice and the profile would compound its own past
-_loaded: Dict[Tuple[str, str, int, str], List[float]] = {}
+_loaded: Dict[Tuple[str, str, int, str], List[float]] = {}  # guarded-by: _lock
 # (schema_fp, op, band) -> decide() count (the exploration schedule)
-_decides: Dict[Tuple[str, str, int], int] = {}
+_decides: Dict[Tuple[str, str, int], int] = {}  # guarded-by: _lock
 # schema_fp -> monotonic expiry of the recompile-storm device penalty
-_penalties: Dict[str, float] = {}
+_penalties: Dict[str, float] = {}  # guarded-by: _lock
 # (schema_fp, arm) -> (monotonic expiry, cost factor) of a per-arm
 # penalty (latency drift: the drifting arm's predictions are INFLATED
 # by the measured regression ratio while it re-learns — soft, unlike
 # the hard device-storm withholding, because "this arm got 1.6x
 # slower" must not force the router onto an arm predicted 4x worse)
-_arm_penalties: Dict[Tuple[str, str], Tuple[float, float]] = {}
-_persist_armed = False
+_arm_penalties: Dict[Tuple[str, str], Tuple[float, float]] = {}  # guarded-by: _lock
+_persist_armed = False  # guarded-by: _lock
 _tls = threading.local()
 
 
@@ -171,6 +171,7 @@ def observe(schema: str, op: str, band: int, arm: str, rows: int,
         return
     x = seconds / rows
     key = (schema, op, int(band), arm)
+    schedtest.yp("costmodel.observe")
     with _lock:
         st = _stats.get(key)
         if st is None:
@@ -492,10 +493,17 @@ def save_profile(path: Optional[str] = None) -> Optional[str]:
         return None
     with _lock:
         own: Dict[Tuple[str, str, int, str], List[float]] = {}
-        for key, st in _stats.items():
+        # pre-save snapshot: observations that land while the disk RMW
+        # below runs are invisible to ``own`` — the rebase recovers them
+        # by diffing the live stats against THIS snapshot (ISSUE 14: the
+        # atexit save raced in-flight observe() and silently erased its
+        # evidence between the own-compute and the rebase clear)
+        pre = {key: list(st) for key, st in _stats.items()}
+        for key, st in pre.items():
             contrib = _subtract(st, _loaded.get(key))
             if contrib is not None and contrib[0] > 0:
                 own[key] = contrib
+    schedtest.yp("costmodel.save")
     # serialize concurrent savers (two processes exiting together):
     # without the lock, both read the same disk doc and the second
     # rename silently drops the first writer's evidence. flock is
@@ -553,11 +561,24 @@ def save_profile(path: Optional[str] = None) -> Optional[str]:
             except OSError:
                 pass
     with _lock:
+        # evidence observed while the file RMW ran: live minus the
+        # pre-save snapshot. Folded back into the rebased stats but NOT
+        # into the loaded baseline — it was never written, so the next
+        # save still contributes it. (Aging that halved counts in the
+        # window can make the diff vanish; that loss is bounded to the
+        # window and counted nowhere because it cannot be detected.)
+        late = {}
+        for key, st in _stats.items():
+            d = _subtract(st, pre.get(key))
+            if d is not None and d[0] > 0:
+                late[key] = d
         _stats.clear()
         _loaded.clear()
         for key, st in merged.items():
             _stats[key] = list(st)
             _loaded[key] = list(st)
+        for key, d in late.items():
+            _stats[key] = _combine(_stats.get(key), d)
     metrics.inc("router.profile_saved")
     return path
 

@@ -64,17 +64,21 @@ __all__ = [
 
 _lock = threading.Lock()
 # (fingerprint, kind, bucket) -> per-executable stats
-_registry: Dict[Tuple[str, str, str], Dict[str, Any]] = {}
+_registry: Dict[Tuple[str, str, str], Dict[str, Any]] = {}  # guarded-by: _lock
 # device id -> last-seen memory_stats watermarks
-_memory: Dict[str, Dict[str, Any]] = {}
+_memory: Dict[str, Dict[str, Any]] = {}  # guarded-by: _lock
 # fingerprint -> monotonic timestamps of recent compiles (churn window)
-_compile_log: Dict[str, deque] = {}
+_compile_log: Dict[str, deque] = {}  # guarded-by: _lock
 
 # the objects whose dicts actually pin jit executables and host arenas
 # (DeviceDecoder, ShardedDecoder, DeviceEncoder, ShardedEncoder):
 # weak-tracked so the lifecycle planes (ISSUE 12) can enumerate and
-# evict without keeping any pipeline alive themselves
-_holders: "weakref.WeakSet" = weakref.WeakSet()
+# evict without keeping any pipeline alive themselves. Guarded (ISSUE
+# 14): a WeakSet iterated by a lifecycle sweep while a fresh pipeline
+# registers on another thread raises "set changed size during
+# iteration" — adds and enumeration snapshots serialize on _lock (GC
+# removals are internally deferred by WeakSet's iteration guard).
+_holders: "weakref.WeakSet" = weakref.WeakSet()  # guarded-by: _lock
 
 # when no memory_analysis is available for an executable, account this
 # much per registry row (explicit estimate, documented in README)
@@ -87,7 +91,8 @@ def track_holder(holder) -> None:
     ``_jit_caches()`` method returning the dicts whose values are (or
     contain) :class:`InstrumentedJit` instances, and/or ``_arenas`` +
     ``_arena_used`` dicts guarded by ``_lock``."""
-    _holders.add(holder)
+    with _lock:
+        _holders.add(holder)
 
 
 def churn_window_s() -> float:
@@ -142,7 +147,7 @@ def sync_mode() -> bool:
 # ---------------------------------------------------------------------------
 
 
-def _entry(key: Tuple[str, str, str]) -> Dict[str, Any]:
+def _entry_locked(key: Tuple[str, str, str]) -> Dict[str, Any]:
     """Get-or-create a registry row; callers hold ``_lock``."""
     e = _registry.get(key)
     if e is None:
@@ -173,7 +178,7 @@ def note_compile(fingerprint: str, kind: str, bucket: str, seconds: float,
     storm = False
     now = time.monotonic()
     with _lock:
-        e = _entry((fingerprint, kind, bucket))
+        e = _entry_locked((fingerprint, kind, bucket))
         e["compiles"] += 1
         e["compile_s"] = round(e["compile_s"] + seconds, 9)
         e["last_used"] = now
@@ -212,7 +217,7 @@ def note_compile(fingerprint: str, kind: str, bucket: str, seconds: float,
 def _note_launch(fingerprint: str, kind: str, bucket: str,
                  seconds: float) -> None:
     with _lock:
-        e = _entry((fingerprint, kind, bucket))
+        e = _entry_locked((fingerprint, kind, bucket))
         e["launches"] += 1
         e["launch_s"] = round(e["launch_s"] + seconds, 9)
         e["last_used"] = time.monotonic()
@@ -220,7 +225,7 @@ def _note_launch(fingerprint: str, kind: str, bucket: str,
 
 def _note_hit(fingerprint: str, kind: str, bucket: str) -> None:
     with _lock:
-        e = _entry((fingerprint, kind, bucket))
+        e = _entry_locked((fingerprint, kind, bucket))
         e["hits"] += 1
         e["last_used"] = time.monotonic()
 
@@ -322,6 +327,11 @@ class InstrumentedJit:
         if self._exe is None:
             with self._ilock:
                 if self._exe is None:
+                    # blocking-ok: _ilock serializes THIS executable's
+                    # one-time XLA compile — concurrent callers of the
+                    # same (schema, bucket) wait for one compile
+                    # instead of paying one each; per-instance leaf
+                    # lock, never nested
                     return self._compile_and_run(args)
         metrics.inc("device.jit_cache.hits")
         _note_hit(self.fingerprint, self.kind, self.bucket)
@@ -338,6 +348,8 @@ class InstrumentedJit:
         if self._exe is None:
             with self._ilock:
                 if self._exe is None:
+                    # blocking-ok: first-compile serialization, same
+                    # audit as __call__ above
                     return self._compile_and_run(args)
         metrics.inc("device.jit_cache.hits")
         _note_hit(self.fingerprint, self.kind, self.bucket)
@@ -583,9 +595,10 @@ def _evict_executable(key_str: str) -> bool:
         return False
     with _lock:
         gone = _registry.pop((fingerprint, kind, bucket), None)
+        holders = list(_holders)
     if gone is None:
         return False
-    for h in list(_holders):
+    for h in holders:
         caches = getattr(h, "_jit_caches", None)
         if caches is None:
             continue
@@ -605,7 +618,9 @@ def _evict_executable(key_str: str) -> bool:
 
 def _arena_entries():
     out = []
-    for h in list(_holders):
+    with _lock:
+        holders = list(_holders)
+    for h in holders:
         arenas = getattr(h, "_arenas", None)
         if arenas is None:
             continue
@@ -619,7 +634,9 @@ def _arena_entries():
 
 def _evict_arena(ent_key) -> bool:
     hid, key = ent_key
-    for h in list(_holders):
+    with _lock:
+        holders = list(_holders)
+    for h in holders:
         if id(h) != hid:
             continue
         arenas = getattr(h, "_arenas", None)

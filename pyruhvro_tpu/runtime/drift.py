@@ -47,7 +47,7 @@ _PENALTY_WINDOW_S = 60.0
 
 _lock = threading.Lock()
 # (schema, op, band, arm) -> [fast, slow, n, sustain, detections]
-_state: Dict[Tuple[str, str, int, str], List[float]] = {}
+_state: Dict[Tuple[str, str, int, str], List[float]] = {}  # guarded-by: _lock
 
 
 def _ratio() -> float:

@@ -114,15 +114,16 @@ def hang_seconds() -> float:
 
 _lock = threading.Lock()
 # parsed plan memo: (raw env string, {site: (kind, rate)})
-_plan_memo: Optional[Tuple[str, Dict[str, Tuple[str, float]]]] = None
+_plan_memo: Optional[Tuple[str, Dict[str, Tuple[str, float]]]] = None  # guarded-by: _lock
 # per-site deterministic call counters (seed folds in as a phase shift)
-_counters: Dict[str, int] = {}
+_counters: Dict[str, int] = {}  # guarded-by: _lock
 
 
-def _parse(raw: str) -> Dict[str, Tuple[str, float]]:
+def _parse_locked(raw: str) -> Dict[str, Tuple[str, float]]:
     """``site:kind:rate[:seed]`` comma list -> {site: (kind, rate)};
-    seeds are applied to the counters as a phase shift at parse time.
-    Malformed entries count ``fault.config_error`` and are dropped."""
+    seeds are applied to the counters as a phase shift at parse time;
+    callers hold ``_lock``. Malformed entries count
+    ``fault.config_error`` and are dropped."""
     plan: Dict[str, Tuple[str, float]] = {}
     for item in raw.split(","):
         item = item.strip()
@@ -155,7 +156,7 @@ def _plan() -> Dict[str, Tuple[str, float]]:
         return memo[1]
     with _lock:
         if _plan_memo is None or _plan_memo[0] != raw:
-            _plan_memo = (raw, _parse(raw) if raw else {})
+            _plan_memo = (raw, _parse_locked(raw) if raw else {})
         return _plan_memo[1]
 
 

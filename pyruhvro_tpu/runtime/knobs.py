@@ -233,6 +233,21 @@ _reg("PYRUHVRO_TPU_MEM_TOPK", "int", 64,
      "Heavy-hitter sketch size for per-(tenant, schema) memory "
      "attribution (space-saving top-k).")
 
+# ---- concurrency correctness ----------------------------------------------
+_reg("PYRUHVRO_TPU_TSAN", "bool", False,
+     "Build/load the ThreadSanitizer-instrumented native modules "
+     "(separate cached .tsan flavor; run python under the libtsan "
+     "preload — see scripts/analysis_gate.py --tsan).")
+_reg("PYRUHVRO_TPU_SCHED_SEED", "int", None,
+     "Pin the deterministic interleaving harness's schedule seed "
+     "(runtime/schedtest.py) for a local race repro.")
+_reg("PYRUHVRO_TPU_SCHED_SEEDS", "int", 20,
+     "Seeds the CI interleave leg sweeps per race window "
+     "(tests/test_concurrency.py seed-sweep tests).")
+_reg("PYRUHVRO_TPU_SCHED_POINTS", "str", "",
+     "Comma list restricting which named schedtest yield-points "
+     "participate in a harness run (empty = all).")
+
 
 # ---------------------------------------------------------------------------
 # accessors
@@ -258,6 +273,7 @@ def get(name: str) -> Knob:
 # signal-safety lint enforces, which cannot see this cross-module
 # chain. bump() is increment-only (signal-safe); pending deltas flush
 # on the next metrics.snapshot() (see metrics._flush_hooks).
+# lock-free-ok(setdefault is GIL-atomic and DeferredCount absorbs racing bumps)
 _parse_error_counts: Dict[str, metrics.DeferredCount] = {}
 
 

@@ -45,7 +45,7 @@ import threading
 import time
 from typing import Any, Callable, Dict, List, Optional
 
-from . import cachelife, knobs, metrics
+from . import cachelife, knobs, metrics, schedtest
 
 __all__ = [
     "register_probe",
@@ -62,7 +62,7 @@ __all__ = [
 ]
 
 _lock = threading.Lock()
-_probes: Dict[str, Callable[[], Dict[str, float]]] = {}
+_probes: Dict[str, Callable[[], Dict[str, float]]] = {}  # guarded-by: _lock
 
 # estimates for ring records whose true per-entry size would need a
 # json.dumps per snapshot to measure (documented, deliberately coarse)
@@ -122,7 +122,13 @@ def high_water_bytes() -> int:
 # is also simply cheaper under scrape + snapshot + report traffic.
 _COLLECT_TTL_S = 1.0
 _collect_lock = threading.Lock()
-_collect_memo: Optional[tuple] = None  # (monotonic, caches, rss)
+_collect_memo: Optional[tuple] = None  # guarded-by: _collect_lock
+# generation stamp against the collect-vs-reset race (ISSUE 14): a
+# probe walk that started before a reset() must not re-publish its
+# pre-reset sample into the memo/gauges after the reset lands — the
+# walk captures the generation up front and its results are discarded
+# when reset() bumped it meanwhile (the next collect() samples fresh)
+_collect_gen = 0  # guarded-by: _collect_lock
 
 
 def _collect_full(force: bool = False):
@@ -131,12 +137,15 @@ def _collect_full(force: bool = False):
     now = time.monotonic()
     with _collect_lock:
         memo = _collect_memo
+        gen = _collect_gen
         if not force and memo is not None and now - memo[0] < _COLLECT_TTL_S:
             return memo[1], memo[2]
+    schedtest.yp("memacct.collect")
     with _lock:
         probes = list(_probes.items())
     out: Dict[str, Dict[str, float]] = {}
     total = 0.0
+    gauge_writes = []
     for name, fn in probes:
         try:
             res = fn() or {}
@@ -146,14 +155,22 @@ def _collect_full(force: bool = False):
             continue
         out[name] = res
         total += b
-        metrics.set_gauge(f"mem.{name}.bytes", b)
+        gauge_writes.append((f"mem.{name}.bytes", b))
         if "items" in res:
-            metrics.set_gauge(f"mem.{name}.items", float(res["items"]))
+            gauge_writes.append((f"mem.{name}.items", float(res["items"])))
     rss = rss_bytes()
-    metrics.set_gauge("mem.rss_bytes", float(rss))
-    metrics.set_gauge("mem.tracked_bytes", total)
+    gauge_writes.append(("mem.rss_bytes", float(rss)))
+    gauge_writes.append(("mem.tracked_bytes", total))
+    schedtest.yp("memacct.collect.store")
     with _collect_lock:
-        _collect_memo = (now, out, rss)
+        if _collect_gen == gen:
+            _collect_memo = (now, out, rss)
+            # publish under the generation check too: a reset that beat
+            # us here cleared the gauges, and re-publishing a pre-reset
+            # sample would resurrect them (metrics._lock nests inside
+            # _collect_lock; both are leaf-cheap, no blocking work)
+            for key, val in gauge_writes:
+                metrics.set_gauge(key, val)
     return out, rss
 
 
@@ -319,7 +336,7 @@ def attribute(tenant: Optional[str], schema_fp: str, op: str, rows: int,
 _TICK_MIN_INTERVAL_S = 1.0
 
 _tick_lock = threading.Lock()
-_tick_last = 0.0
+_tick_last = 0.0  # guarded-by: _tick_lock
 
 
 def tick() -> None:
@@ -440,10 +457,13 @@ def render_mem_report(snap: Dict[str, Any]) -> str:
 
 def reset() -> None:
     """Clear the attribution sketch, the tick throttle and the collect
-    memo (test isolation; probes are module wiring and survive)."""
-    global _tick_last, _collect_memo
+    memo (test isolation; probes are module wiring and survive). Bumps
+    the collect generation so an in-flight probe walk cannot re-publish
+    its pre-reset sample (see :func:`_collect_full`)."""
+    global _tick_last, _collect_memo, _collect_gen
     _sketch.reset()
     with _tick_lock:
         _tick_last = 0.0
     with _collect_lock:
+        _collect_gen += 1
         _collect_memo = None

@@ -31,9 +31,9 @@ __all__ = ["inc", "merge", "snapshot", "reset", "timer", "record_deltas",
            "set_gauge", "gauges"]
 
 _lock = threading.Lock()
-_counters: Dict[str, float] = defaultdict(float)
-_gauges: Dict[str, float] = {}
-_marks: Dict[str, float] = {}
+_counters: Dict[str, float] = defaultdict(float)  # guarded-by: _lock
+_gauges: Dict[str, float] = {}  # guarded-by: _lock
+_marks: Dict[str, float] = {}  # guarded-by: _lock
 _tls = threading.local()
 
 
@@ -161,7 +161,7 @@ class DeferredCount:
 # a flush callback here; snapshot() runs them (lock NOT held) so
 # deferred deltas are never invisible to a reader. Hooks must be
 # idempotent and cheap.
-_flush_hooks: list = []
+_flush_hooks: list = []  # lock-free-ok(append-only registration at import; snapshot's iteration tolerates a concurrent append)
 
 
 def register_flush_hook(fn) -> None:

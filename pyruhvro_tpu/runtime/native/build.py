@@ -17,7 +17,7 @@ import threading
 
 _HERE = os.path.dirname(os.path.abspath(__file__))
 _lock = threading.Lock()
-_modules: dict = {}
+_modules: dict = {}  # guarded-by: _lock
 
 
 def _ext_suffix() -> str:
@@ -58,6 +58,28 @@ def _san_active() -> bool:
     from .. import knobs
 
     return knobs.get_bool("PYRUHVRO_TPU_NATIVE_SAN")
+
+
+# the ThreadSanitizer build flavor (ISSUE 14): a third cached flavor
+# exactly like .san, but instrumented for the data-race detector — the
+# dynamic complement of the static lock-graph pass. TSan and ASan
+# runtimes cannot coexist in one process, so NATIVE_SAN wins when both
+# knobs are set (the gate never sets both). Run python under the
+# libtsan preload via ``scripts/analysis_gate.py --tsan``.
+_TSAN_FLAGS = (
+    "-fsanitize=thread",
+    "-fno-omit-frame-pointer",
+    "-g",
+)
+
+
+def _tsan_active() -> bool:
+    """PYRUHVRO_TPU_TSAN=1 selects the ThreadSanitizer-instrumented
+    build of every JIT-compiled module (ignored when the ASan flavor is
+    also requested — the runtimes are mutually exclusive)."""
+    from .. import knobs
+
+    return knobs.get_bool("PYRUHVRO_TPU_TSAN") and not _san_active()
 
 
 def _cpu_tag() -> str:
@@ -133,14 +155,17 @@ def _compile(so: str, src: str, extra_flags=()) -> None:
 
 
 def _load(mod_name: str, src_file: str, prof: bool = False,
-          san: bool = None):
+          san: bool = None, tsan: bool = None):
     """Compile-if-stale and import one extension module (memoized;
     None is memoized too so a broken toolchain is probed once).
     ``prof=True`` builds/loads the profiled variant to a distinct cached
     file (``<mod>.prof<EXT_SUFFIX>``); ``san=True`` (default: the
     PYRUHVRO_TPU_NATIVE_SAN knob) the ASan+UBSan-instrumented one
-    (``<mod>.san<EXT_SUFFIX>``, composable with prof). Every variant
-    exports the same module name, so any satisfies the PyInit lookup."""
+    (``<mod>.san<EXT_SUFFIX>``, composable with prof); ``tsan=True``
+    (default: the PYRUHVRO_TPU_TSAN knob) the ThreadSanitizer one
+    (``<mod>.tsan<EXT_SUFFIX>``, also composable with prof, mutually
+    exclusive with san). Every variant exports the same module name, so
+    any satisfies the PyInit lookup."""
     from .. import faults
 
     try:
@@ -155,21 +180,33 @@ def _load(mod_name: str, src_file: str, prof: bool = False,
         return None
     if san is None:
         san = _san_active()
-    key = mod_name + ("@san" if san else "") + ("@prof" if prof else "")
+    if tsan is None:
+        tsan = _tsan_active()
+    if san:
+        tsan = False  # the two runtimes cannot share a process
+    key = (mod_name + ("@san" if san else "") + ("@tsan" if tsan else "")
+           + ("@prof" if prof else ""))
     if key in _modules:
         return _modules[key]
     with _lock:
         if key in _modules:
             return _modules[key]
         so = _so_path(mod_name + (".san" if san else "")
+                      + (".tsan" if tsan else "")
                       + (".prof" if prof else ""))
         src = os.path.join(_HERE, src_file)
         flags = ("-DPYRUHVRO_NATIVE_PROF=1",) if prof else ()
         if san:
             flags += _SAN_FLAGS
+        if tsan:
+            flags += _TSAN_FLAGS
         try:
             if _needs_build(so, src):
                 try:
+                    # blocking-ok: first-import JIT — _lock exists to
+                    # serialize exactly this g++ run; duplicating the
+                    # compile costs more than waiting, and the lock is
+                    # a leaf (no other lock is ever taken under it)
                     _compile(so, src, flags)
                 except Exception as e:
                     # a wheel-built .so in a read-only site-packages can
@@ -189,6 +226,8 @@ def _load(mod_name: str, src_file: str, prof: bool = False,
                     )
             spec = importlib.util.spec_from_file_location(mod_name, so)
             mod = importlib.util.module_from_spec(spec)
+            # blocking-ok: one-time dlopen/exec of the built module,
+            # serialized by design (see the _compile waiver above)
             spec.loader.exec_module(mod)
             _modules[key] = mod
         except Exception:
@@ -203,8 +242,9 @@ def loaded_host_codec_with(symbol: str):
     can call it freely; a stale .so without the symbol makes the guard
     site and the dispatch site fall back together. Prefers the profiled
     variant when PYRUHVRO_TPU_NATIVE_PROF selects it (and the sanitizer
-    flavor when PYRUHVRO_TPU_NATIVE_SAN does)."""
-    san = "@san" if _san_active() else ""
+    flavor when PYRUHVRO_TPU_NATIVE_SAN / PYRUHVRO_TPU_TSAN does)."""
+    san = ("@san" if _san_active()
+           else "@tsan" if _tsan_active() else "")
     base = "_pyruhvro_hostcodec" + san
     keys = (base + "@prof", base) if _prof_active() else (base,)
     for key in keys:
@@ -219,6 +259,7 @@ def load_native():
     return _load("_pyruhvro_native", "packer.cpp")
 
 
+# lock-free-ok(set.add is GIL-atomic; worst case a duplicate warning)
 _prof_fallback_warned: set = set()
 
 

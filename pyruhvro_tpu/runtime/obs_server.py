@@ -56,7 +56,7 @@ __all__ = [
 _DEFAULT_HEALTH_WINDOW_S = 60.0
 
 _lock = threading.Lock()
-_server: Optional["ObsServer"] = None
+_server: Optional["ObsServer"] = None  # guarded-by: _lock
 
 
 def _health_window_s() -> float:

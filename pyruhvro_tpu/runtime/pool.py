@@ -47,8 +47,8 @@ from . import breaker, deadline, knobs, metrics, telemetry
 __all__ = ["get_pool", "map_chunks", "get_process_pool", "map_chunks_proc",
            "pool_mode", "process_available", "fanout_stats"]
 
-_pool = None
-_proc_pool = None
+_pool = None       # guarded-by: _lock
+_proc_pool = None  # guarded-by: _lock
 _lock = threading.Lock()
 
 

@@ -56,8 +56,8 @@ __all__ = [
 _LEDGER_N = max(1, knobs.get_int("PYRUHVRO_TPU_LEDGER_N"))
 
 _lock = threading.Lock()
-_ledger: deque = deque(maxlen=_LEDGER_N)
-_entries_seen = 0
+_ledger: deque = deque(maxlen=_LEDGER_N)  # guarded-by: _lock
+_entries_seen = 0  # guarded-by: _lock
 
 
 class RouteDecision:

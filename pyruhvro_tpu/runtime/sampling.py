@@ -71,18 +71,22 @@ _ALPHA = 0.2
 
 _lock = threading.Lock()
 _tls = threading.local()
-_calls = 0
-_deep_calls = 0
-_period = _PERIOD_START
-_forced: Optional[bool] = None  # SIGUSR2 / set_enabled override
-_overhead = 0.0  # latest weighted overhead-fraction estimate
+_calls = 0          # guarded-by: _lock
+_deep_calls = 0     # guarded-by: _lock
+_period = _PERIOD_START  # guarded-by: _lock
+# lock-free-ok(single GIL-atomic store; written from SIGUSR2 signal
+# context where taking a lock could deadlock the interrupted frame)
+_forced: Optional[bool] = None
+_overhead = 0.0     # guarded-by: _lock (weighted overhead estimate)
+# lock-free-ok(main-thread-only install flag — signal.signal itself
+# rejects non-main threads, so two installers cannot race)
 _signal_installed = False
-_prof_mod_probed = False
-_prof_mod = None
-_prof_thread: Optional[threading.Thread] = None
-_overhead_known = False
-_pending_resample = False
-_skip_streak = 0
+_prof_mod_probed = False    # guarded-by: _lock
+_prof_mod = None            # guarded-by: _lock
+_prof_thread: Optional[threading.Thread] = None  # guarded-by: _lock
+_overhead_known = False     # guarded-by: _lock
+_pending_resample = False   # guarded-by: _lock
+_skip_streak = 0            # guarded-by: _lock
 # (schema, op, band, arm) -> [norm_ewma_spr, deep_ewma_spr, n_norm,
 # n_deep]. The arm (from router.observe via note_arm, None when the
 # call was never routed or ran degraded) is part of the key because the
@@ -90,7 +94,7 @@ _skip_streak = 0
 # pays ~4x to swap its specialized engine for the profiled interpreter
 # while a device call pays only a sync per launch — one blended ratio
 # would over-correct the cheap arm and under-correct the expensive one.
-_feat: Dict[Tuple[Any, ...], list] = {}
+_feat: Dict[Tuple[Any, ...], list] = {}  # guarded-by: _lock
 
 
 def budget() -> float:
@@ -301,7 +305,7 @@ def prof_codec_module():
     return None
 
 
-def _retune() -> None:
+def _retune_locked() -> None:
     """Recompute the overhead estimate and the period from the
     per-feature EWMAs; callers hold ``_lock``. Overhead is the
     deep-call-count-weighted mean of per-feature (deep/normal - 1)
@@ -424,7 +428,7 @@ class call_scope:
                     st[i] + _ALPHA * (spr - st[i]))
                 st[i + 2] += 1.0
                 if sampled:
-                    _retune()
+                    _retune_locked()
         if sampled and deep_ran and _overhead_known:
             # leave the correction behind for the enclosing root span
             # (it exits after this scope and feeds the SLO engine —

@@ -64,16 +64,16 @@ __all__ = [
 ]
 
 _lock = threading.Lock()
-_conf_key: Optional[str] = None  # env value the loaded config came from
-_objectives: List["_Objective"] = []
-_load_error: Optional[str] = None
+_conf_key: Optional[str] = None  # guarded-by: _lock (loaded-config key)
+_objectives: List["_Objective"] = []  # guarded-by: _lock
+_load_error: Optional[str] = None  # guarded-by: _lock
 # ingest-side evaluation throttle: burn windows are seconds long, so
 # evaluating every objective's full window stats on EVERY call would
 # put an O(windows x buckets) scan under the lock in the hot path for
 # verdicts that cannot change faster than a bucket fills. Read paths
 # (breached()/snapshot_slo) always evaluate — a scrape is rare.
 _EVAL_INTERVAL_S = 0.25
-_last_eval = 0.0
+_last_eval = 0.0  # guarded-by: _lock
 
 
 def _as_float(v, default=None):

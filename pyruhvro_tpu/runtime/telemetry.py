@@ -111,10 +111,11 @@ SNAPSHOT_SCHEMA_VERSION = 3
 _FLIGHT_N = max(1, knobs.get_int("PYRUHVRO_TPU_FLIGHT_N"))
 
 _lock = threading.Lock()
-_hists: Dict[str, "_Hist"] = {}
-_spans: deque = deque(maxlen=_MAX_SPANS)
-_flight: deque = deque(maxlen=_FLIGHT_N)
-_roots_seen = 0
+_hists: Dict[str, "_Hist"] = {}  # guarded-by: _lock
+_spans: deque = deque(maxlen=_MAX_SPANS)  # guarded-by: _lock
+_flight: deque = deque(maxlen=_FLIGHT_N)  # guarded-by: _lock
+_roots_seen = 0  # guarded-by: _lock
+# lock-free-ok(single GIL-atomic bool store; readers tolerate staleness)
 _enabled = not knobs.get_bool("PYRUHVRO_TPU_NO_TELEMETRY")
 _tls = threading.local()
 
@@ -168,7 +169,7 @@ class _Hist:
         }
 
 
-def _hist(key: str) -> _Hist:
+def _hist_locked(key: str) -> _Hist:
     """Get-or-create; callers hold ``_lock``."""
     h = _hists.get(key)
     if h is None:
@@ -272,7 +273,7 @@ class root_span:
         metrics.inc(s.name + "_s", s.dur_s)
         global _roots_seen
         with _lock:
-            _hist(s.name + "_s").observe(s.dur_s)
+            _hist_locked(s.name + "_s").observe(s.dur_s)
             if self._prev is None:
                 _spans.append(s)
                 _flight.append(_flight_record(s))
@@ -335,7 +336,7 @@ class phase:
             _tls.span = self._prev
         if _enabled:
             with _lock:
-                _hist(self.key).observe(dt)
+                _hist_locked(self.key).observe(dt)
         return False
 
 
@@ -350,7 +351,7 @@ def observe(key: str, seconds: float, **attrs) -> None:
         return
     parent = getattr(_tls, "span", None)
     with _lock:
-        _hist(key).observe(seconds)
+        _hist_locked(key).observe(seconds)
         if parent is not None:
             s = Span(key, attrs)
             # the interval ENDED at creation: shift ts back so the span's
@@ -370,7 +371,7 @@ def observe_value(key: str, value: float) -> None:
     if not _enabled:
         return
     with _lock:
-        _hist(key).observe(value)
+        _hist_locked(key).observe(value)
 
 
 def annotate(**attrs) -> None:
@@ -419,9 +420,11 @@ def set_route(tier: str, reason: Optional[str] = None) -> None:
 # gate), or explicitly via :func:`flight_dump`. ``PYRUHVRO_TPU_FLIGHT_N``
 # sizes the ring (default 64).
 
+# lock-free-ok(mutated from signal context where locks deadlock; a racing
+# pair costs at worst one extra dump / a reused dump filename)
 _flight_seq = 0
-_flight_last_auto = 0.0
-_flight_signal_installed = False
+_flight_last_auto = 0.0  # lock-free-ok(see _flight_seq above)
+_flight_signal_installed = False  # lock-free-ok(idempotent install flag)
 
 
 def _flight_record(s: Span) -> Dict[str, Any]:
@@ -1020,7 +1023,7 @@ def perfetto_trace(snap: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
 # -- JSON-lines trace stream (opt-in) ---------------------------------------
 
 _trace_lock = threading.Lock()
-_trace_memo: Optional[tuple] = None  # (path, file handle | None)
+_trace_memo: Optional[tuple] = None  # guarded-by: _trace_lock (path, file handle | None)
 
 
 def _trace_sink():

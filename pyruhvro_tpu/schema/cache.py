@@ -28,7 +28,7 @@ from typing import Dict, Optional
 
 import pyarrow as pa
 
-from ..runtime import cachelife, knobs, memacct, metrics, telemetry
+from ..runtime import cachelife, knobs, memacct, metrics, schedtest, telemetry
 from .arrow_map import to_arrow_schema
 from .model import AvroType
 from .parser import parse_schema
@@ -86,6 +86,7 @@ class SchemaEntry:
             return self._extras[key]
         except KeyError:
             pass
+        schedtest.yp("schema_cache.memo")
         with self._lock:
             if key not in self._extras:
                 self._extras[key] = factory()
@@ -122,7 +123,7 @@ class SchemaEntry:
         return n
 
 
-_cache: Dict[str, SchemaEntry] = {}
+_cache: Dict[str, SchemaEntry] = {}  # guarded-by: _cache_lock
 _cache_lock = threading.Lock()
 
 
@@ -132,12 +133,14 @@ def get_or_parse_schema(schema_str: str) -> SchemaEntry:
     entry = _cache.get(schema_str)
     if entry is not None:
         metrics.inc("schema_cache.hits")
+        schedtest.yp("schema_cache.get")
         entry.last_used = time.monotonic()
         return entry
     metrics.inc("schema_cache.misses")
     t0 = time.perf_counter()
     ir = parse_schema(schema_str)  # parse outside the lock; parsing is pure
     telemetry.observe("schema_cache.parse_s", time.perf_counter() - t0)
+    schedtest.yp("schema_cache.insert")
     with _cache_lock:
         entry = _cache.get(schema_str)
         if entry is None:
@@ -167,6 +170,7 @@ def _evict(key: str) -> bool:
     """Unlink one entry. In-flight calls hold their own reference and
     finish on it; the next ``get_or_parse_schema`` re-parses (counted
     as a miss) and rebuilds every derived object bit-identically."""
+    schedtest.yp("schema_cache.evict")
     with _cache_lock:
         gone = _cache.pop(key, None)
     if gone is None:

@@ -10,10 +10,16 @@ Passes:
 2. AST invariant lints (``pyruhvro_tpu/analysis/lints``) — knob reads
    outside the registry, signal-unsafe metrics/locks, non-atomic JSON
    writes, uncounted fault-seam swallows;
-3. README knob-table drift — the table between the
+3. the concurrency-correctness pass (ISSUE 14,
+   ``pyruhvro_tpu/analysis/concurrency``) — lock-order inversion
+   cycles in the acquired-while-held graph, locks held across blocking
+   seams, and the guarded-by discipline over ``runtime/`` module
+   globals; the lock inventory, edge list and audited waiver list land
+   in the report;
+4. README knob-table drift — the table between the
    ``<!-- knob-table:start/end -->`` markers must equal
    ``knobs.render_markdown_table()`` (``--fix-knob-table`` rewrites it);
-4. optionally (``--sanitize``) the native differential suites under
+5. optionally (``--sanitize``) the native differential suites under
    ASan+UBSan: the host-codec/extractor/fused-decode modules rebuild
    with ``-fsanitize=address,undefined`` (separate cache flavor,
    ``runtime/native/build.py``) and the differential + quick
@@ -21,7 +27,13 @@ Passes:
    suite failure is retried ONCE in a fresh interpreter (the PR 8
    isolated-rerun convention, lifted to suite granularity) so ASan's
    2-4x memory/time overhead cannot turn container-load flakes into
-   red gates; a failure that reproduces isolated is the verdict.
+   red gates; a failure that reproduces isolated is the verdict;
+6. optionally (``--tsan``) the same differential suites PLUS the
+   threaded legs of ``tests/test_concurrency.py`` against the
+   ThreadSanitizer flavor (``.tsan`` cache key, ``PYRUHVRO_TPU_TSAN``)
+   under the libtsan preload, gating on zero data-race reports — the
+   dynamic complement of the static lock-graph pass. Same
+   isolated-rerun deflake rule; a real TSan report is never retried.
 
 Always writes ``ANALYSIS_REPORT.json`` (per-pass findings, the full
 knob inventory, sanitizer summary) — CI uploads it as an artifact next
@@ -41,6 +53,7 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from pyruhvro_tpu.analysis import Finding  # noqa: E402
+from pyruhvro_tpu.analysis import concurrency  # noqa: E402
 from pyruhvro_tpu.analysis.contracts import check_contracts  # noqa: E402
 from pyruhvro_tpu.analysis.lints import run_lints  # noqa: E402
 from pyruhvro_tpu.runtime import fsio, knobs  # noqa: E402
@@ -53,9 +66,19 @@ _TABLE_END = "<!-- knob-table:end -->"
 # the sanitizer leg: native differential suites + quick malformed-fuzz
 # seeds (the not-slow half; CI's perf job owns the full sweep)
 _SAN_SUITES = (
-    "tests/test_native_extract.py",
-    "tests/test_fused_decode.py",
-    "tests/test_fuzz_malformed.py",
+    ("tests/test_native_extract.py", ()),
+    ("tests/test_fused_decode.py", ()),
+    ("tests/test_fuzz_malformed.py", ()),
+)
+
+# the TSan leg (ISSUE 14): the native differentials again — this time
+# hunting data races, not memory bugs — plus the explicitly-threaded
+# legs of the concurrency suite (concurrent native decode/encode over
+# the GIL-released VM, the exact shape ROADMAP item 3 will make hotter)
+_TSAN_SUITES = (
+    ("tests/test_native_extract.py", ()),
+    ("tests/test_fused_decode.py", ()),
+    ("tests/test_concurrency.py", ("-k", "threaded")),
 )
 
 
@@ -103,12 +126,12 @@ def check_knob_table(root: str, fix: bool = False):
 # ---------------------------------------------------------------------------
 
 
-def _san_runtime_paths():
+def _runtime_libs(names):
     gxx = shutil.which("g++")
     if not gxx:
         return None
     libs = []
-    for lib in ("libasan.so", "libubsan.so"):
+    for lib in names:
         p = subprocess.run([gxx, "-print-file-name=" + lib],
                            capture_output=True, text=True).stdout.strip()
         if not p or p == lib or not os.path.exists(p):
@@ -117,16 +140,26 @@ def _san_runtime_paths():
     return libs
 
 
+def _san_runtime_paths():
+    return _runtime_libs(("libasan.so", "libubsan.so"))
+
+
 _SAN_REPORT_RE = re.compile(
     r"AddressSanitizer|UndefinedBehaviorSanitizer|runtime error:|"
     r"LeakSanitizer|heap-buffer-overflow|heap-use-after-free")
 
+_TSAN_REPORT_RE = re.compile(
+    r"WARNING: ThreadSanitizer|ThreadSanitizer: data race|"
+    r"ThreadSanitizer: reported \d+ warnings")
 
-def _run_one_suite(suite: str, env: dict, timeout: int):
+
+def _run_one_suite(suite, env: dict, timeout: int,
+                   report_re=_SAN_REPORT_RE):
+    path, extra = suite if isinstance(suite, tuple) else (suite, ())
     t0 = time.monotonic()
     try:
         proc = subprocess.run(
-            [sys.executable, "-m", "pytest", suite, "-q", "-m",
+            [sys.executable, "-m", "pytest", path, *extra, "-q", "-m",
              "not slow", "-p", "no:cacheprovider", "-p", "no:randomly"],
             cwd=REPO, env=env, capture_output=True, text=True,
             timeout=timeout,
@@ -139,10 +172,10 @@ def _run_one_suite(suite: str, env: dict, timeout: int):
         out = ((e.stdout or "") if isinstance(e.stdout, str) else ""
                ) + f"\n[analysis_gate] suite timed out after {timeout}s"
     return {
-        "suite": suite,
+        "suite": " ".join((path,) + tuple(extra)),
         "returncode": rc,
         "seconds": round(time.monotonic() - t0, 1),
-        "sanitizer_report": bool(_SAN_REPORT_RE.search(out)),
+        "sanitizer_report": bool(report_re.search(out)),
         "tail": out.splitlines()[-8:],
     }
 
@@ -173,31 +206,81 @@ def run_sanitizer_suites(timeout_per_suite: int = 1800):
         UBSAN_OPTIONS="print_stacktrace=1:halt_on_error=1",
     )
     summary = {"ran": True, "preload": libs, "suites": []}
-    for suite in _SAN_SUITES:
-        res = _run_one_suite(suite, env, timeout_per_suite)
+    findings.extend(_drive_suites(_SAN_SUITES, env, timeout_per_suite,
+                                  summary, "sanitize", "ASan/UBSan",
+                                  _SAN_REPORT_RE))
+    return summary, findings
+
+
+def _drive_suites(suites, env, timeout_per_suite, summary, tag, what,
+                  report_re):
+    """Shared suite driver for the ASan and TSan legs: run each suite,
+    apply the PR 8 isolated-rerun deflake rule (a genuine sanitizer
+    report is NEVER retried), collect findings."""
+    findings = []
+    for suite in suites:
+        res = _run_one_suite(suite, env, timeout_per_suite, report_re)
         res["isolated_rerun"] = False
         if res["returncode"] != 0 and not res["sanitizer_report"]:
-            # PR 8 deflake convention at suite granularity: ASan's
+            # PR 8 deflake convention at suite granularity: sanitizer
             # overhead on a loaded container can trip wall-clock
             # assertions — an isolated fresh-interpreter rerun is the
             # verdict; a real sanitizer report is NEVER retried
-            retry = _run_one_suite(suite, env, timeout_per_suite)
+            retry = _run_one_suite(suite, env, timeout_per_suite,
+                                   report_re)
             retry["isolated_rerun"] = True
             res = retry
         summary["suites"].append(res)
         status = ("clean" if res["returncode"] == 0
                   and not res["sanitizer_report"] else "RED")
-        print(f"analysis_gate: sanitize {suite}: {status} "
+        print(f"analysis_gate: {tag} {res['suite']}: {status} "
               f"({res['seconds']}s"
               + (", isolated rerun" if res["isolated_rerun"] else "")
               + ")")
         if res["returncode"] != 0 or res["sanitizer_report"]:
             findings.append(Finding(
-                "sanitize.suite", suite,
+                f"{tag}.suite", res["suite"],
                 ("sanitizer report in output" if res["sanitizer_report"]
                  else f"suite failed (rc={res['returncode']}) under "
-                      "ASan/UBSan")
+                      f"{what}")
                 + " — tail: " + " | ".join(res["tail"][-3:])))
+    return findings
+
+
+def run_tsan_suites(timeout_per_suite: int = 1800):
+    """Run the native differential + threaded suites against the
+    ThreadSanitizer flavor (``.tsan`` cache key) under the libtsan
+    preload, gating on zero data-race reports. Structure mirrors
+    :func:`run_sanitizer_suites` including the isolated-rerun rule."""
+    findings = []
+    libs = _runtime_libs(("libtsan.so",))
+    if libs is None:
+        return ({"ran": False,
+                 "skipped": "no g++/libtsan on this host"},
+                [Finding("tsan.toolchain", "scripts/analysis_gate.py",
+                         "ThreadSanitizer runtime unavailable — the "
+                         "TSan leg cannot run")])
+    env = dict(
+        os.environ,
+        JAX_PLATFORMS="cpu",
+        PYRUHVRO_TPU_TSAN="1",
+        # the interpreter VM serves; the spec cache is flavor-blind
+        PYRUHVRO_TPU_NO_SPECIALIZE="1",
+        LD_PRELOAD=" ".join(libs),
+        # keep going on a report (the grep is the gate, and one red
+        # suite must not hide the others); history_size buys deeper
+        # stacks on the second access of a reported race; the
+        # suppressions file scopes out UNINSTRUMENTED third-party
+        # allocators (pyarrow's mimalloc) whose raw-atomic
+        # synchronization the runtime cannot see — each entry carries
+        # its audit note in scripts/tsan.supp
+        TSAN_OPTIONS="halt_on_error=0:history_size=4:suppressions="
+                     + os.path.join(REPO, "scripts", "tsan.supp"),
+    )
+    summary = {"ran": True, "preload": libs, "suites": []}
+    findings.extend(_drive_suites(_TSAN_SUITES, env, timeout_per_suite,
+                                  summary, "tsan", "ThreadSanitizer",
+                                  _TSAN_REPORT_RE))
     return summary, findings
 
 
@@ -217,6 +300,10 @@ def main(argv=None) -> int:
     ap.add_argument("--sanitize", action="store_true",
                     help="also run the native differential suites under "
                          "ASan+UBSan (rebuilds the .san flavor)")
+    ap.add_argument("--tsan", action="store_true",
+                    help="also run the native differential + threaded "
+                         "suites under ThreadSanitizer (rebuilds the "
+                         ".tsan flavor, preloads libtsan)")
     ap.add_argument("--skip-generative", action="store_true",
                     help="skip the import-based specializer-table check "
                          "(pure-parse contract checks only)")
@@ -227,12 +314,18 @@ def main(argv=None) -> int:
     passes["contracts"] = contracts
     lints = run_lints(REPO)
     passes["lints"] = lints
+    conc_findings, conc_info = concurrency.analyze(REPO)
+    passes["concurrency"] = conc_findings
     passes["knob_table"] = check_knob_table(REPO, fix=args.fix_knob_table)
 
     sanitizer = {"ran": False}
     if args.sanitize:
         sanitizer, san_findings = run_sanitizer_suites()
         passes["sanitize"] = san_findings
+    tsan = {"ran": False}
+    if args.tsan:
+        tsan, tsan_findings = run_tsan_suites()
+        passes["tsan"] = tsan_findings
 
     all_findings = [f for fs in passes.values() for f in fs]
     report = {
@@ -245,6 +338,11 @@ def main(argv=None) -> int:
         "finding_count": len(all_findings),
         "knobs": knobs.inventory(),
         "sanitizer": sanitizer,
+        "tsan": tsan,
+        # the lock-graph evidence (ISSUE 14): inventory, the
+        # acquired-while-held edges, guarded-global declarations and
+        # the audited waiver list
+        "concurrency": conc_info,
     }
     fsio.atomic_write_json(args.report, report, indent=1)
 

@@ -428,7 +428,7 @@ def test_sampling_correction_is_per_arm():
     with sampling._lock:
         # native pair measured: deep costs 4x normal
         sampling._feat[native] = [1e-6, 4e-6, 8.0, 8.0]
-        sampling._retune()
+        sampling._retune_locked()
     assert sampling.overhead_known()
     # same feature + arm: the measured 4x divides out
     assert sampling.corrected_seconds(4.0, *native) == pytest.approx(1.0)
