@@ -1,6 +1,6 @@
-"""Repo-native static-analysis plane (ISSUE 11 + 14).
+"""Repo-native static-analysis plane (ISSUE 11 + 14 + 15).
 
-Four coupled passes, run as one CI gate (``scripts/analysis_gate.py``):
+Five coupled passes, run as one CI gate (``scripts/analysis_gate.py``):
 
 1. :mod:`.contracts` — the cross-language opcode contract checker. The
    fused decode path mirrors one contract in four hand-synchronized
@@ -13,14 +13,24 @@ Four coupled passes, run as one CI gate (``scripts/analysis_gate.py``):
 2. :mod:`.lints` — AST invariant lints: no direct ``PYRUHVRO_TPU_*``
    env reads outside ``runtime/knobs.py``, no metrics/lock acquisition
    reachable from a registered signal handler, no whole-file
-   ``json.dump`` outside ``runtime/fsio.py``, and no swallowed
-   ``FaultInjected`` without a counted metric.
+   ``json.dump`` outside ``runtime/fsio.py``, no swallowed
+   ``FaultInjected`` without a counted metric, and (ISSUE 15) the
+   metric-key contract: every statically-extracted telemetry key in
+   the generated README registry, no dead documented keys.
 3. :mod:`.concurrency` — the concurrency-correctness pass (ISSUE 14):
    lock-order inversion cycles over the acquired-while-held graph,
    locks held across blocking seams, and the ``# guarded-by:`` /
    ``# lock-free-ok(...)`` discipline for ``runtime/`` module globals,
    with an audited waiver list exported to the report.
-4. sanitizer builds — ``runtime/native/build.py``'s ASan/UBSan flavor
+4. :mod:`.irverify` — the IR verification plane (ISSUE 15): abstract
+   interpretation over the compiled hostpath opcode programs (generic
+   lowering AND the specializer's generated units, decode + encode) —
+   type/effect discipline, wire-progress/termination proofs,
+   int32/int64 overflow lanes against anchored native guards, and
+   generic<->specialized effect-trace equivalence — driven across the
+   full schema-construct lattice with a seeded mutation self-test
+   (``analysis_gate.py --ir``, ``IR_VERIFY_REPORT.json``).
+5. sanitizer builds — ``runtime/native/build.py``'s ASan/UBSan flavor
    (gate ``--sanitize``) and ThreadSanitizer flavor (``--tsan``, the
    dynamic complement of the lock-graph pass), each with its own CI
    job.

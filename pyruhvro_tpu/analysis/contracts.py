@@ -119,6 +119,87 @@ def parse_py_constants(path: str, prefix: str) -> Dict[str, int]:
     return out
 
 
+def parse_err_mappings(path: str) -> Dict[str, Dict[str, str]]:
+    """The ``ERR_NAMES`` / ``ERR_SLUGS`` dict literals of
+    ``ops/varint.py`` as ``{dict_name: {ERR_CONST: string}}`` — the
+    Python exception wording (MalformedAvro messages) and the machine
+    slugs (quarantine attribution) per error bit."""
+    with open(path, encoding="utf-8") as f:
+        tree = ast.parse(f.read(), filename=path)
+    out: Dict[str, Dict[str, str]] = {}
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Assign) and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and node.targets[0].id in ("ERR_NAMES", "ERR_SLUGS")
+                and isinstance(node.value, ast.Dict)):
+            continue
+        m: Dict[str, str] = {}
+        for k, v in zip(node.value.keys, node.value.values):
+            if (isinstance(k, ast.Name)
+                    and isinstance(v, ast.Constant)
+                    and isinstance(v.value, str)):
+                m[k.id] = v.value
+        out[node.targets[0].id] = m
+    return out
+
+
+def check_error_taxonomy(root: str) -> List[Finding]:
+    """ISSUE 15 satellite: every C++ ``Err`` enum value must map to a
+    Python exception path — an ``ERR_NAMES`` message (the MalformedAvro
+    wording ``hostpath/codec.py`` raises) and an ``ERR_SLUGS`` slug
+    (the quarantine channel's attribution) — and each slug must be
+    exercised by at least one test (the quoted slug literal appears in
+    ``tests/``). An error bit no test can produce is an error path
+    nobody has ever seen work."""
+    findings: List[Finding] = []
+    vm_core_h = os.path.join(
+        root, "pyruhvro_tpu/runtime/native/host_vm_core.h")
+    varint_py = os.path.join(root, "pyruhvro_tpu/ops/varint.py")
+    varint_rel = "pyruhvro_tpu/ops/varint.py"
+    cpp_errs = parse_cpp_enum(vm_core_h, "Err")
+    if not cpp_errs:
+        return [Finding("contract.err-taxonomy",
+                        "pyruhvro_tpu/runtime/native/host_vm_core.h",
+                        "Err enum not parsed")]
+    maps = parse_err_mappings(varint_py)
+    names = maps.get("ERR_NAMES", {})
+    slugs = maps.get("ERR_SLUGS", {})
+    if not names or not slugs:
+        return [Finding("contract.err-taxonomy", varint_rel,
+                        "ERR_NAMES/ERR_SLUGS dicts not parsed")]
+    test_dir = os.path.join(root, "tests")
+    blob = ""
+    try:
+        for fn in sorted(os.listdir(test_dir)):
+            if fn.endswith(".py"):
+                with open(os.path.join(test_dir, fn),
+                          encoding="utf-8") as f:
+                    blob += f.read()
+    except OSError:
+        pass
+    for cname in sorted(cpp_errs):
+        if cname not in names:
+            findings.append(Finding(
+                "contract.err-taxonomy", varint_rel,
+                f"C++ Err member {cname} has no ERR_NAMES message — "
+                "the native VM can set a bit the Python raise path "
+                "cannot word"))
+        if cname not in slugs:
+            findings.append(Finding(
+                "contract.err-taxonomy", varint_rel,
+                f"C++ Err member {cname} has no ERR_SLUGS slug — the "
+                "quarantine channel cannot attribute it"))
+            continue
+        slug = slugs[cname]
+        if (f'"{slug}"' not in blob) and (f"'{slug}'" not in blob):
+            findings.append(Finding(
+                "contract.err-taxonomy", "tests/",
+                f"error code {cname} (slug {slug!r}) is exercised by "
+                "no test — craft a wire input that trips it and assert "
+                "MalformedAvro.err_name"))
+    return findings
+
+
 def parse_py_aux_tags(path: str) -> set:
     """The aux TAG strings ``hostpath/program.py`` emits: first elements
     of tuples assigned into ``self.aux[...]``."""
@@ -423,6 +504,9 @@ def check_contracts(root: str, generative: bool = True) -> List[Finding]:
                     "contract.aux-tags", rel,
                     f"references unknown AuxLane member(s) "
                     f"{sorted(unknown)}"))
+
+    # -- 7. error-taxonomy coverage (ISSUE 15) ----------------------------
+    findings.extend(check_error_taxonomy(root))
 
     if generative:
         findings.extend(_check_specializer_tables())

@@ -25,6 +25,11 @@ reviewer memory:
   handler that swallows ``FaultInjected`` (the 12 chaos seams of
   ``runtime/faults.py``) must count a metric: a degradation that does
   not count is a degradation nobody will ever see.
+* ``lint.metric-keys`` (ISSUE 15) — the telemetry key contract: every
+  statically-extracted counter/gauge/mark/span key (plus the C++
+  profiler drain keys) must appear in the generated README registry
+  table, and every key-shaped token in README prose must name a key
+  the code still emits (no dead documentation).
 
 The analysis is deliberately path-INsensitive (a ``metrics.inc`` behind
 ``if counters:`` still flags) — that keeps it trivially sound, and the
@@ -35,6 +40,7 @@ from __future__ import annotations
 
 import ast
 import os
+import re
 from typing import Dict, Iterable, List, Optional, Sequence, Set
 
 from . import Finding
@@ -44,6 +50,9 @@ __all__ = [
     "lint_signal_safety",
     "lint_json_writes",
     "lint_fault_seams",
+    "metric_key_registry",
+    "render_metric_key_table",
+    "lint_metric_keys",
     "run_lints",
     "iter_py_files",
 ]
@@ -382,12 +391,288 @@ def lint_fault_seams(files: Iterable[str], root: str = ".") -> List[Finding]:
 
 
 # ---------------------------------------------------------------------------
+# lint.metric-keys (ISSUE 15): the telemetry key contract
+# ---------------------------------------------------------------------------
+#
+# Every counter/gauge/mark/span key the package emits is statically
+# extracted — ``metrics.inc/observe/set_gauge/mark/timer`` and
+# ``telemetry.phase/root_span`` first-argument literals (f-string and
+# ``"lit" + expr`` call sites register as dotted PREFIXES), plus the
+# C++ native-profiler drain keys (``kDomPrefix`` x ``kSlotName`` and
+# their ``_s`` self-time twins, parsed with the ISSUE 11 contract
+# parsers). The registry renders as a generated README table between
+# the ``<!-- metric-keys:start/end -->`` markers (the knob-table
+# pattern: docs generated from code cannot drift) and the gate fails
+# both directions: a key emitted but missing from the committed table
+# (undocumented), and a key-shaped token in README prose that matches
+# no emitted key (dead documentation).
+
+_METRIC_PRODUCERS = {
+    ("metrics", "inc"): "counter",
+    ("metrics", "observe"): "histogram",
+    ("metrics", "set_gauge"): "gauge",
+    ("metrics", "mark"): "event",
+    ("metrics", "timer"): "seconds",
+    ("telemetry", "phase"): "span",
+    ("telemetry", "root_span"): "span",
+    ("telemetry", "observe"): "span",
+    # memory-plane probe names become the mem.<plane>.* gauge namespace
+    ("memacct", "register_probe"): "plane",
+}
+
+# Dynamically-built keys (f-strings with no literal head, name+suffix
+# concatenations, relay loops) declare themselves in place with an
+# audited ``# metric-key: <key-pattern>`` comment on or just above the
+# producing line — the same in-place-waiver idiom as ``# signal-ok`` /
+# ``# blocking-ok``. ``<seg>`` / ``*`` are wildcards.
+_KEY_DECL = re.compile(r"#\s*metric-key:\s*(\S+)")
+
+_KEY_TABLE_START = "<!-- metric-keys:start -->"
+_KEY_TABLE_END = "<!-- metric-keys:end -->"
+
+
+def _key_literal(node: ast.Call):
+    """(key, is_prefix) of a producer call's first argument: a constant
+    string, the leading constant of an f-string, or the left constant
+    of ``"lit" + expr`` — else (None, False) for fully dynamic relays
+    (the keys they forward come from literal sites elsewhere)."""
+    if not node.args:
+        return None, False
+    a = node.args[0]
+    if isinstance(a, ast.Constant) and isinstance(a.value, str):
+        return a.value, False
+    if isinstance(a, ast.JoinedStr) and a.values:
+        head = a.values[0]
+        if isinstance(head, ast.Constant) and isinstance(head.value, str):
+            return head.value, True
+        return None, False
+    if isinstance(a, ast.BinOp) and isinstance(a.op, ast.Add):
+        left = a.left
+        if isinstance(left, ast.Constant) and isinstance(left.value, str):
+            return left.value, True
+    return None, False
+
+
+def metric_key_registry(root: str) -> Dict[str, dict]:
+    """key -> {kind, prefix, sources} over the package tree plus the
+    native profiler's drain-key tables."""
+    registry: Dict[str, dict] = {}
+
+    def add(key, kind, src, prefix=False):
+        rec = registry.setdefault(key, {"kind": kind, "prefix": prefix,
+                                        "sources": []})
+        if src not in rec["sources"]:
+            rec["sources"].append(src)
+        rec["prefix"] = rec["prefix"] or prefix
+
+    for path in iter_py_files(root, ("pyruhvro_tpu",)):
+        rel = _rel(path, root).replace(os.sep, "/")
+        if rel.startswith("pyruhvro_tpu/analysis/"):
+            continue  # the analyzers' own sources hold example patterns
+        tree, lines = _parse(path)
+        for ln in lines:
+            dm = _KEY_DECL.search(ln)
+            if dm:
+                add(dm.group(1), "declared", rel,
+                    prefix=dm.group(1).endswith("."))
+        for node in ast.walk(tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and isinstance(node.func.value, ast.Name)):
+                continue
+            kind = _METRIC_PRODUCERS.get(
+                (node.func.value.id, node.func.attr))
+            if kind is None:
+                continue
+            key, prefix = _key_literal(node)
+            if key is None:
+                continue
+            if kind in ("counter", "seconds") and key.endswith("_s"):
+                kind = "seconds"
+            add(key, kind, rel, prefix)
+
+    # the C++ drain keys (hostpath/codec.py feeds them to metrics.inc
+    # verbatim, plus the "_s" self-time twin per key)
+    from .contracts import parse_cpp_string_array
+
+    vm_core = os.path.join(
+        root, "pyruhvro_tpu/runtime/native/host_vm_core.h")
+    rel = "pyruhvro_tpu/runtime/native/host_vm_core.h"
+    try:
+        prefixes = parse_cpp_string_array(vm_core, "kDomPrefix")
+        slots = parse_cpp_string_array(vm_core, "kSlotName")
+    except OSError:  # fixture trees without the native core
+        prefixes, slots = [], []
+    for p in prefixes:
+        for s in slots:
+            add(p + s, "counter", rel)
+            add(p + s + "_s", "seconds", rel)
+    return registry
+
+
+def render_metric_key_table(registry: Dict[str, dict]) -> str:
+    """The generated README block: the native drain families collapse
+    to their ``<prefix>.<opcode>`` wildcard rows (16+ opcode keys per
+    domain would drown the table), everything else is one row per key;
+    trailing-dot prefixes render with a ``<...>`` placeholder."""
+    from .contracts import parse_cpp_string_array  # noqa: F401 (doc)
+
+    rows = []
+    seen_fam = set()
+    for key in sorted(registry):
+        rec = registry[key]
+        fam = None
+        for dom in ("vm.op.", "vm.encop.", "extract.op."):
+            if key.startswith(dom):
+                fam = dom
+        if fam is not None:
+            if fam in seen_fam:
+                continue
+            seen_fam.add(fam)
+            rows.append((f"`{fam}<opcode>[_s]`", "counter/seconds",
+                         "native profiler drain (host_vm_core.h "
+                         "kDomPrefix x kSlotName)"))
+            continue
+        shown = f"`{key}<...>`" if rec["prefix"] or key.endswith(".") \
+            else f"`{key}`"
+        rows.append((shown, rec["kind"],
+                     ", ".join(s.rsplit("/", 1)[-1]
+                               for s in rec["sources"][:3])))
+    out = ["| key | kind | emitted by |", "| --- | --- | --- |"]
+    out += [f"| {k} | {kind} | {src} |" for k, kind, src in rows]
+    return "\n".join(out) + "\n"
+
+
+def _doc_key_tokens(text: str, root: str = "."):
+    """Key-shaped backtick tokens in README prose: dotted lowercase
+    identifiers that are not file paths, module paths, or attribute
+    references; ``<...>``/``[...]``/``*`` segments are documentation
+    wildcards."""
+    out = []
+    for m in re.finditer(r"`([^`\n]+)`", text):
+        tok = m.group(1)
+        if "/" in tok or "(" in tok or " " in tok or "=" in tok:
+            continue
+        # segments start alphanumeric (or a wildcard): `pool._broken`
+        # is an attribute reference, not a key
+        if re.fullmatch(
+                r"[a-z][a-z0-9_]*(\.[a-z0-9<*\[][a-z0-9_<>.*\[\]]*)+",
+                tok):
+            if tok.rsplit(".", 1)[-1] in ("py", "json", "md", "cpp",
+                                          "h", "jsonl", "yml", "avsc"):
+                continue
+            # `fallback.decoder.decode_records`-style module/function
+            # references: the leading segments name a package module
+            segs = tok.split(".")
+            if os.path.exists(os.path.join(
+                    root, "pyruhvro_tpu", segs[0], segs[1] + ".py")):
+                continue
+            out.append((tok, text[: m.start()].count("\n") + 1))
+    return out
+
+
+def _wild_rx(s: str):
+    """Regex for a key with ``<seg>`` / ``[seg]`` / ``*`` wildcards
+    (used by both documented tokens and ``# metric-key`` patterns)."""
+    parts = re.split(r"(<[^>]*>|\[[^\]]*\]|\*)", s)
+    return re.compile("^" + "".join(
+        re.escape(p) if i % 2 == 0 else "[A-Za-z0-9_.-]+"
+        for i, p in enumerate(parts)) + "$")
+
+
+def _doc_token_matches(tok: str, registry: Dict[str, dict]) -> bool:
+    """Does a documented token name at least one emitted key? Wildcard
+    segments match anything on either side; a token that is a dotted
+    family prefix of an emitted key (or extends an emitted trailing-dot
+    prefix) also matches."""
+    tok_rx = _wild_rx(tok)
+    sample = re.sub(r"<[^>]*>|\[[^\]]*\]|\*", "x", tok)
+    for key, rec in registry.items():
+        if "<" in key or "*" in key or "[" in key:
+            # a declared pattern: match pattern-vs-sample
+            if _wild_rx(key).match(sample):
+                return True
+            continue
+        if tok_rx.match(key):
+            return True
+        if rec["prefix"] and (sample.startswith(key)
+                              or key.startswith(sample)):
+            return True
+        if key.startswith(tok + ".") or key.startswith(tok + "_"):
+            # a documented family name ("slo.breach" covers
+            # "slo.breach.<name>")
+            return True
+    return False
+
+
+def lint_metric_keys(root: str, fix: bool = False) -> List[Finding]:
+    """Both directions of the key contract: the committed README table
+    must equal the fresh registry rendering (``--fix-metric-keys``
+    rewrites it), and every key-shaped token in README prose must name
+    an emitted key."""
+    findings: List[Finding] = []
+    registry = metric_key_registry(root)
+    lint_metric_keys.last_registry = registry  # report material
+    readme = os.path.join(root, "README.md")
+    try:
+        with open(readme, encoding="utf-8") as f:
+            text = f.read()
+    except OSError:
+        return [Finding("lint.metric-keys", "README.md",
+                        "README.md unreadable")]
+    want = render_metric_key_table(registry)
+    m = re.search(re.escape(_KEY_TABLE_START) + r"\n(.*?)"
+                  + re.escape(_KEY_TABLE_END), text, flags=re.S)
+    if m is None:
+        findings.append(Finding(
+            "lint.metric-keys", "README.md",
+            f"metric-key registry markers missing ({_KEY_TABLE_START} "
+            f"... {_KEY_TABLE_END}) — the table is generated from the "
+            "statically-extracted key registry"))
+    elif m.group(1) != want:
+        if fix:
+            text = text[: m.start(1)] + want + text[m.end(1):]
+            with open(readme, "w", encoding="utf-8") as f:
+                f.write(text)
+            # re-anchor the match on the REWRITTEN text: the dead-key
+            # scan below slices around it, and stale offsets would
+            # misalign the prose
+            m = re.search(re.escape(_KEY_TABLE_START) + r"\n(.*?)"
+                          + re.escape(_KEY_TABLE_END), text, flags=re.S)
+            print("analysis_gate: rewrote the README metric-key table "
+                  "from the extracted registry")
+        else:
+            findings.append(Finding(
+                "lint.metric-keys", "README.md",
+                "metric-key table drifted from the emitted keys — a "
+                "key was added/removed without documentation; run "
+                "scripts/analysis_gate.py --fix-metric-keys",
+                text[: m.start(1)].count("\n") + 1))
+    # dead documentation: prose keys outside the generated block that
+    # match no emitted key
+    prose = text
+    if m is not None:
+        prose = text[: m.start(1)] + text[m.end(1):]
+    emitted_roots = {k.split(".", 1)[0] for k in registry}
+    for tok, line in _doc_key_tokens(prose, root):
+        if tok.split(".", 1)[0] not in emitted_roots:
+            continue  # not a telemetry family (api params, attrs, ...)
+        if not _doc_token_matches(tok, registry):
+            findings.append(Finding(
+                "lint.metric-keys", "README.md",
+                f"documented key {tok!r} is emitted nowhere (dead "
+                "key) — the docs promise telemetry the code no longer "
+                "produces", line))
+    return findings
+
+
+# ---------------------------------------------------------------------------
 # the combined pass
 # ---------------------------------------------------------------------------
 
 
-def run_lints(root: str = ".") -> List[Finding]:
-    """All four lints over the package tree (plus scripts/ and bench.py
+def run_lints(root: str = ".", fix_metric_keys: bool = False) -> List[Finding]:
+    """All five lints over the package tree (plus scripts/ and bench.py
     for the json-write rule — CI artifacts torn mid-write poison later
     runs exactly like profile files do)."""
     pkg = iter_py_files(root, ("pyruhvro_tpu",))
@@ -401,4 +686,5 @@ def run_lints(root: str = ".") -> List[Finding]:
         json_scope.append(bench)
     findings.extend(lint_json_writes(json_scope, root))
     findings.extend(lint_fault_seams(pkg, root))
+    findings.extend(lint_metric_keys(root, fix=fix_metric_keys))
     return findings

@@ -193,6 +193,14 @@ def read_bytes(buf, pos: int):
     ln, pos = read_long(buf, pos)
     if ln < 0:
         raise MalformedAvro(f"negative bytes/string length {ln}", err_name="neg_len")
+    if ln > 0x7FFFFFFF:
+        # parity with the native VM's string_len_i32 guard (ISSUE 15,
+        # host_vm_core.h rd_string): the host lens lanes and the Arrow
+        # Binary offsets are int32, so a >2GiB single value is rejected
+        # at the wire, never silently wrapped downstream
+        raise MalformedAvro(
+            f"bytes/string length {ln} exceeds int32 capacity",
+            err_name="overrun")
     if pos + ln > len(buf):
         raise MalformedAvro("truncated bytes/string payload", err_name="overrun")
     return bytes(buf[pos : pos + ln]), pos + ln

@@ -34,7 +34,8 @@ from ..schema.model import (
     Union,
 )
 
-__all__ = ["HostProgram", "lower_host", "COL_NBUF"]
+__all__ = ["HostProgram", "lower_host", "COL_NBUF", "OP_NAMES",
+           "OP_EFFECTS"]
 
 # op kinds (≙ host_codec.cpp OpKind)
 OP_RECORD, OP_INT, OP_LONG, OP_FLOAT, OP_DOUBLE, OP_BOOL = 0, 1, 2, 3, 4, 5
@@ -46,6 +47,82 @@ COL_I32, COL_I64, COL_F32, COL_F64, COL_U8, COL_STR, COL_OFFS = range(7)
 
 # buffers each column type contributes (COL_STR: value bytes + len i32)
 COL_NBUF = {COL_STR: 2}
+
+OP_NAMES = {
+    OP_RECORD: "record", OP_INT: "int", OP_LONG: "long",
+    OP_FLOAT: "float", OP_DOUBLE: "double", OP_BOOL: "bool",
+    OP_STRING: "string", OP_ENUM: "enum", OP_NULL: "null",
+    OP_NULLABLE: "nullable", OP_UNION: "union", OP_ARRAY: "array",
+    OP_MAP: "map", OP_FIXED: "fixed", OP_DEC_BYTES: "dec_bytes",
+    OP_DEC_FIXED: "dec_fixed",
+}
+
+# Per-opcode effect contract, the machine-readable half of what the two
+# native engines implement (ISSUE 15: the IR verifier abstract-interprets
+# programs against THIS table, and anchors every declared guard to the
+# C++ source it names). Fields:
+#   ctype      — required ColType of the op's primary column (None = no
+#                column); the map KEY column (op.b) is always COL_STR.
+#   min_wire   — minimum wire bytes one present execution consumes
+#                ("a" = the op's size operand). Array/map items whose
+#                subtree floor is 0 are legal ONLY because both engines
+#                charge zero-width items against kMaxZeroWidthItems.
+#   pushes     — buffer lanes appended per present execution of the op
+#                itself (items repeat per item, handled by the walker).
+#   sinks      — int32-narrowing lanes this op writes, as
+#                (lane, (guard, ...)): every guard names an anchor the
+#                verifier greps out of the native sources, so deleting a
+#                C++ range check (or this declaration) fails the gate.
+#   aux        — aux tags permitted on the op (None = no aux legal);
+#                "!tag" marks a REQUIRED tag.
+OP_EFFECTS = {
+    OP_RECORD: dict(ctype=None, min_wire=0, pushes=(), sinks=(),
+                    aux=(None,)),
+    OP_INT: dict(ctype=COL_I32, min_wire=1, pushes=("i32",),
+                 # the 64-bit zigzag is truncated to its low 32 bits by
+                 # contract (matches the device walk)
+                 sinks=(("int_value", ("int_low32_by_design",)),),
+                 aux=(None,)),
+    OP_LONG: dict(ctype=COL_I64, min_wire=1, pushes=("i64",), sinks=(),
+                  aux=(None,)),
+    OP_FLOAT: dict(ctype=COL_F32, min_wire=4, pushes=("f32",), sinks=(),
+                   aux=(None,)),
+    OP_DOUBLE: dict(ctype=COL_F64, min_wire=8, pushes=("f64",), sinks=(),
+                    aux=(None,)),
+    OP_BOOL: dict(ctype=COL_U8, min_wire=1, pushes=("u8",), sinks=(),
+                  aux=(None,)),
+    OP_STRING: dict(ctype=COL_STR, min_wire=1, pushes=("u8", "i32"),
+                    # the wire length lands in the int32 lens lane: it
+                    # must be bounded by the remaining span AND by
+                    # int32 (a >2GiB datum could otherwise wrap it)
+                    sinks=(("string_len",
+                            ("string_len_span", "string_len_i32")),),
+                    aux=(None, "uuid", "binary")),
+    OP_ENUM: dict(ctype=COL_I32, min_wire=1, pushes=("i32",),
+                  sinks=(("enum_index", ("enum_range",)),),
+                  aux=("!enum",)),
+    OP_NULL: dict(ctype=None, min_wire=0, pushes=(), sinks=(),
+                  aux=(None,)),
+    OP_NULLABLE: dict(ctype=COL_U8, min_wire=1, pushes=("u8",), sinks=(),
+                      aux=(None,)),
+    OP_UNION: dict(ctype=COL_I32, min_wire=1, pushes=("i32",),
+                   sinks=(("union_tid", ("union_branch_range",)),),
+                   aux=(None,)),
+    OP_ARRAY: dict(ctype=COL_OFFS, min_wire=1, pushes=("i32",),
+                   sinks=(("offs_running", ("offs_running_i32",)),
+                          ("merge_rebase", ("merge_offsets_i32",))),
+                   aux=(None,)),
+    OP_MAP: dict(ctype=COL_OFFS, min_wire=1, pushes=("i32",),
+                 sinks=(("offs_running", ("offs_running_i32",)),
+                        ("merge_rebase", ("merge_offsets_i32",))),
+                 aux=(None,)),
+    OP_FIXED: dict(ctype=COL_U8, min_wire="a", pushes=("u8",), sinks=(),
+                   aux=(None, "duration")),
+    OP_DEC_BYTES: dict(ctype=COL_U8, min_wire=1, pushes=("u8",), sinks=(),
+                       aux=("!decimal",)),
+    OP_DEC_FIXED: dict(ctype=COL_U8, min_wire="a", pushes=("u8",),
+                       sinks=(), aux=("!decimal",)),
+}
 
 # numpy dtypes per buffer, in buffer order
 _COL_DTYPES = {
@@ -80,6 +157,27 @@ class HostProgram:
     # per op — None, ("uuid",), ("binary",), ("duration",),
     # ("decimal", precision) or ("enum", symbol_bytes, ...)
     op_aux: tuple = ()
+
+    def op_effects(self) -> List[dict]:
+        """Per-op resolved effect rows for the IR verifier (ISSUE 15):
+        the :data:`OP_EFFECTS` contract with the op's operands folded in
+        (``min_wire="a"`` resolves to the size operand; required aux
+        tags are checked by the verifier, not here)."""
+        out = []
+        aux = self.op_aux or tuple(None for _ in range(len(self.ops)))
+        for pc, row in enumerate(self.ops):
+            kind, a, b, col, nops, _pad = (int(x) for x in row)
+            eff = OP_EFFECTS[kind]
+            mw = eff["min_wire"]
+            out.append({
+                "pc": pc, "kind": kind, "name": OP_NAMES[kind],
+                "a": a, "b": b, "col": col, "nops": nops,
+                "ctype": eff["ctype"],
+                "min_wire": a if mw == "a" else mw,
+                "pushes": eff["pushes"], "sinks": eff["sinks"],
+                "aux_allowed": eff["aux"], "aux": aux[pc],
+            })
+        return out
 
     def buffer_plan(self) -> List[Tuple[str, object, int]]:
         """Flat (host_key, dtype, region) per returned buffer, in the

@@ -71,6 +71,19 @@ class _GenBase:
         self.indent = indent
         self.uid = 0
         self.cols_used: set = set()
+        # effect-event journal (ISSUE 15): every subtree the generator
+        # emits records (mode, pc, kind, col) — "live" for the
+        # present=True spine, "cond" for bodies guarded by a minted
+        # bool, "default" for statically-absent bodies. The IR verifier
+        # diffs this journal (and the EFFECTS-v1 trailer rendering it)
+        # against its own abstract execution of the program, which
+        # catches codegen drift the embedded-table diff cannot (a body
+        # that pushes the wrong column still embeds the right table).
+        self.effects: List[tuple] = []
+
+    def note(self, mode: str, pc: int) -> None:
+        kind, _a, _b, col = (int(x) for x in self.ops[pc][:4])
+        self.effects.append((mode, pc, kind, col))
 
     def w(self, line: str) -> None:
         self.lines.append("  " * self.indent + line)
@@ -105,6 +118,7 @@ class _Gen(_GenBase):
         reads — what ``Vm::exec(present=false)`` does per row, unrolled.
         The branch-table union arms and the null side of two-version
         nullables are built from this."""
+        self.note("default", pc)
         kind, a, b, col, nops, _pad = (int(x) for x in self.ops[pc])
         if kind == OP_RECORD:
             q = pc + 1
@@ -158,6 +172,7 @@ class _Gen(_GenBase):
         Mirrors ``Vm::exec`` (host_codec.cpp) case-for-case."""
         if present is False:
             return self.gen_default(pc)
+        self.note("live" if present is True else "cond", pc)
         kind, a, b, col, nops, _pad = (int(x) for x in self.ops[pc])
         p = "true" if present is True else present
 
@@ -374,6 +389,7 @@ class _EncGen(_GenBase):
         """The statically-ABSENT encode body: advance the entry cursors
         without emitting a byte — what ``EncVm::exec(present=false)``
         does, unrolled (non-selected union arms, null nullable sides)."""
+        self.note("default", pc)
         kind, a, b, col, nops, _pad = (int(x) for x in self.ops[pc])
         if kind == OP_RECORD:
             q = pc + 1
@@ -428,6 +444,7 @@ class _EncGen(_GenBase):
     def gen(self, pc: int, present) -> int:
         if present is False:
             return self.gen_default(pc)
+        self.note("live" if present is True else "cond", pc)
         kind, a, b, col, nops, _pad = (int(x) for x in self.ops[pc])
         p = "true" if present is True else present
 
@@ -728,8 +745,14 @@ def _static_tables(prog: HostProgram) -> str:
 
 
 def generate_source(prog: HostProgram, mod_name: str,
-                    core_include: str = "../arrow_decode_core.h") -> str:
-    """The C++ translation unit for one schema's decoder + encoder."""
+                    core_include: str = "../arrow_decode_core.h",
+                    with_effects: bool = False) -> str:
+    """The C++ translation unit for one schema's decoder + encoder.
+
+    ``with_effects=True`` appends the machine-readable ``EFFECTS-v1``
+    trailer (the generators' effect-event journals as one JSON line) for
+    the IR verifier's equivalence diff; production callers leave it off
+    so cached sources stay byte-stable."""
     g = _Gen(prog.ops)
     g.gen(0, True)
     col_refs = "\n".join(
@@ -740,7 +763,7 @@ def generate_source(prog: HostProgram, mod_name: str,
     enc_col_refs = "\n".join(
         f"    InCol& C{c} = cols[{c}];" for c in sorted(eg.cols_used)
     )
-    return _TEMPLATE.format(
+    src = _TEMPLATE.format(
         core=core_include,
         mod=mod_name,
         static_tables=_static_tables(prog),
@@ -749,6 +772,15 @@ def generate_source(prog: HostProgram, mod_name: str,
         enc_col_refs=enc_col_refs,
         enc_body="\n".join(eg.lines),
     )
+    if with_effects:
+        import json as _json
+
+        trailer = _json.dumps(
+            {"decode": [list(e) for e in g.effects],
+             "encode": [list(e) for e in eg.effects]},
+            separators=(",", ":"))
+        src += f"\n// EFFECTS-v1 {trailer}\n"
+    return src
 
 
 def _native_dir() -> str:

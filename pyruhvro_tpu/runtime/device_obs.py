@@ -388,6 +388,7 @@ class InstrumentedJit:
 
         metrics.inc("device.jit_cache.misses")
         if self.family:
+            # metric-key: <op>.compiles
             metrics.inc(self.family + ".compiles")
         faults.fire("device_compile")
         t0 = time.perf_counter()
@@ -459,6 +460,7 @@ class InstrumentedJit:
             dt = time.perf_counter() - t1
             metrics.inc("device.jit_cache.misses")
             if self.family:
+                # metric-key: <op>.compiles
                 metrics.inc(self.family + ".compiles")
             telemetry.observe("device.compile_s", dt, kind=self.kind,
                               bucket=self.bucket, mode="aot_degrade")
@@ -468,6 +470,7 @@ class InstrumentedJit:
             out = self._block(out)
         dt = time.perf_counter() - t0
         if count_family_launch and self.family:
+            # metric-key: <op>.launches
             metrics.inc(self.family + ".launches")
         telemetry.observe("device.launch_s", dt, kind=self.kind,
                           bucket=self.bucket,

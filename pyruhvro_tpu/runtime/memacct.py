@@ -155,11 +155,15 @@ def _collect_full(force: bool = False):
             continue
         out[name] = res
         total += b
+        # metric-key: mem.<plane>.bytes
         gauge_writes.append((f"mem.{name}.bytes", b))
         if "items" in res:
+            # metric-key: mem.<plane>.items
             gauge_writes.append((f"mem.{name}.items", float(res["items"])))
     rss = rss_bytes()
+    # metric-key: mem.rss_bytes
     gauge_writes.append(("mem.rss_bytes", float(rss)))
+    # metric-key: mem.tracked_bytes
     gauge_writes.append(("mem.tracked_bytes", total))
     schedtest.yp("memacct.collect.store")
     with _collect_lock:

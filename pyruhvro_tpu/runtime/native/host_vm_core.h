@@ -406,6 +406,16 @@ inline void rd_string(Col& c, Reader& r, bool present) {
       r.err |= ERR_OVERRUN;
       len = 0;
     }
+    // the length lands in the int32 lens lane below: with no datum cap
+    // (PYRUHVRO_TPU_MAX_DATUM_BYTES=0) a >2GiB record could otherwise
+    // pass the span check and silently wrap the cast — surfaced by the
+    // IR verifier's overflow pass (irverify.overflow: string_len_i32;
+    // fallback/io.py read_bytes applies the same bound so every tier
+    // agrees on accept-vs-reject)
+    if (len > (int64_t)INT32_MAX) {
+      r.err |= ERR_OVERRUN;
+      len = 0;
+    }
     if (len) {
       if (len <= 16 && r.end - r.cur >= 16)
         c.u8.append_wide16(r.base + r.cur, (size_t)len);

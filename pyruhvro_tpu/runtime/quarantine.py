@@ -134,14 +134,17 @@ def publish(entries: List[QuarantinedRecord], policy: str,
     # are exclusive per call (pool fan-out OR local chunks).
     merged = min(getattr(_tls, "merged", 0), len(entries))
     if merged == 0:
+        # metric-key: <op>.quarantined
         metrics.inc(op + ".quarantined", float(len(entries)))
         for e in entries:
+            # metric-key: <op>.quarantine.<slug>
             metrics.inc(f"{op}.quarantine.{e.error}")
     elif merged < len(entries):
         # mixed source (shouldn't happen per call; defensive): count
         # the locally-detected remainder without slug attribution
         metrics.inc(op + ".quarantined", float(len(entries) - merged))
     if len(entries) >= _storm_threshold():
+        # metric-key: <op>.quarantine_storms
         metrics.inc(op + ".quarantine_storms")
         metrics.mark("quarantine_storm")  # the live /healthz bit
         telemetry._flight_autodump("quarantine")

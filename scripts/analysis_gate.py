@@ -19,6 +19,18 @@ Passes:
 4. README knob-table drift — the table between the
    ``<!-- knob-table:start/end -->`` markers must equal
    ``knobs.render_markdown_table()`` (``--fix-knob-table`` rewrites it);
+   the metric-key registry table between the
+   ``<!-- metric-keys:start/end -->`` markers is held to the same
+   standard against the statically-extracted key registry
+   (``--fix-metric-keys`` rewrites it);
+4b. optionally (``--ir``, ISSUE 15) the IR verification plane
+   (``pyruhvro_tpu/analysis/irverify``): abstract interpretation over
+   the compiled hostpath opcode programs — type/effect discipline,
+   wire-progress/termination, int32/int64 overflow lanes vs anchored
+   native guards, and generic<->specialized effect-trace equivalence —
+   driven across the full schema-construct lattice with a seeded
+   mutation self-test; writes ``IR_VERIFY_REPORT.json`` (per-point
+   verdicts, 100%% lattice coverage asserted, mutation verdicts);
 5. optionally (``--sanitize``) the native differential suites under
    ASan+UBSan: the host-codec/extractor/fused-decode modules rebuild
    with ``-fsanitize=address,undefined`` (separate cache flavor,
@@ -297,6 +309,18 @@ def main(argv=None) -> int:
     ap.add_argument("--fix-knob-table", action="store_true",
                     help="rewrite the README knob table from the "
                          "registry instead of failing on drift")
+    ap.add_argument("--fix-metric-keys", action="store_true",
+                    help="rewrite the README metric-key registry table "
+                         "from the extracted keys instead of failing "
+                         "on drift")
+    ap.add_argument("--ir", action="store_true",
+                    help="run the IR verification plane (abstract "
+                         "interpretation over the opcode programs + "
+                         "lattice coverage + mutation self-test)")
+    ap.add_argument("--ir-report",
+                    default=os.path.join(REPO, "IR_VERIFY_REPORT.json"),
+                    help="where --ir writes the lattice/mutation "
+                         "verdicts")
     ap.add_argument("--sanitize", action="store_true",
                     help="also run the native differential suites under "
                          "ASan+UBSan (rebuilds the .san flavor)")
@@ -312,11 +336,33 @@ def main(argv=None) -> int:
     passes = {}
     contracts = check_contracts(REPO, generative=not args.skip_generative)
     passes["contracts"] = contracts
-    lints = run_lints(REPO)
+    lints = run_lints(REPO, fix_metric_keys=args.fix_metric_keys)
     passes["lints"] = lints
     conc_findings, conc_info = concurrency.analyze(REPO)
     passes["concurrency"] = conc_findings
     passes["knob_table"] = check_knob_table(REPO, fix=args.fix_knob_table)
+
+    ir_summary = {"ran": False}
+    if args.ir:
+        from pyruhvro_tpu.analysis.irverify import run_ir_verification
+
+        ir_findings, ir_report = run_ir_verification(REPO)
+        passes["ir"] = ir_findings
+        fsio.atomic_write_json(args.ir_report, ir_report, indent=1)
+        cov = ir_report["lattice"]["coverage"]
+        ir_summary = {
+            "ran": True,
+            "report": os.path.relpath(args.ir_report, REPO),
+            "coverage_pct": cov["coverage_pct"],
+            "constructible": cov["constructible"],
+            "verified": cov["verified"],
+            "mutation_all_caught": ir_report["mutation"]["all_caught"],
+        }
+        print(f"analysis_gate: ir lattice {cov['verified']}/"
+              f"{cov['constructible']} verified "
+              f"({cov['coverage_pct']}%), mutation self-test "
+              + ("all caught" if ir_report["mutation"]["all_caught"]
+                 else "ESCAPES"))
 
     sanitizer = {"ran": False}
     if args.sanitize:
@@ -339,6 +385,7 @@ def main(argv=None) -> int:
         "knobs": knobs.inventory(),
         "sanitizer": sanitizer,
         "tsan": tsan,
+        "ir": ir_summary,
         # the lock-graph evidence (ISSUE 14): inventory, the
         # acquired-while-held edges, guarded-global declarations and
         # the audited waiver list
