@@ -542,6 +542,73 @@ def _measure_deadline_overhead(schema, datums, chunks, reps, details):
          f"(timeout_s=60 {on_s * 1e3:.3f} ms vs off {off_s * 1e3:.3f} ms)")
 
 
+def _measure_otlp_overhead(schema, datums, chunks, details,
+                           calls_per_round: int = 20,
+                           rounds: int = 4):
+    """OTLP-exporter cost vs exporter-off on the kafka headline decode
+    (ISSUE 16 acceptance: sub-1%). The exporter's per-call footprint is
+    one bounded-queue append per finished ROOT span (the flush thread
+    and HTTP POSTs run off the hot path against a local stdlib sink
+    here), so like the sampling probe each measured unit is a BLOCK of
+    calls, alternated exporter-on/exporter-off so machine drift hits
+    both sides; best-of-rounds per side."""
+    import threading
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    from pyruhvro_tpu.api import deserialize_array_threaded
+    from pyruhvro_tpu.runtime import otel
+
+    class _Sink(BaseHTTPRequestHandler):
+        def do_POST(self):
+            self.rfile.read(int(self.headers.get("Content-Length", 0)))
+            self.send_response(200)
+            self.end_headers()
+            self.wfile.write(b"{}")
+
+        def log_message(self, *a):  # noqa: N802 — http.server hook
+            pass
+
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), _Sink)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+
+    def block():
+        t0 = time.perf_counter()
+        for _ in range(calls_per_round):
+            deserialize_array_threaded(datums, schema, chunks,
+                                       backend="host")
+        return time.perf_counter() - t0
+
+    block()  # warmup (caches, specialization)
+    on_s = off_s = float("inf")
+    try:
+        for _ in range(rounds):
+            # a long flush interval keeps the POST cadence out of the
+            # measured blocks: the per-call cost under test is the span
+            # enqueue, which is what a production interval amortizes to
+            otel.start(f"http://127.0.0.1:{srv.server_address[1]}",
+                       interval_s=60.0)
+            on_s = min(on_s, block())
+            otel.stop()
+            off_s = min(off_s, block())
+    finally:
+        otel.stop()
+        srv.shutdown()
+    frac = ((on_s - off_s) / off_s) if off_s > 0 else 0.0
+    budget = 0.01
+    details["otlp_overhead"] = {
+        "workload": (f"deserialize kafka {len(datums)} rows x{chunks} "
+                     f"[host] x{calls_per_round} calls/round"),
+        "enabled_s": round(on_s, 6),
+        "disabled_s": round(off_s, 6),
+        "overhead_frac": round(frac, 4),
+        "budget": budget,
+        "within_budget": frac <= budget + 0.005,  # noise floor
+    }
+    _log(f"[bench] otlp overhead: {frac * 100:.2f}% "
+         f"(budget {budget * 100:.2f}%; on {on_s * 1e3:.3f} ms vs off "
+         f"{off_s * 1e3:.3f} ms per round)")
+
+
 def device_available(schema: str) -> bool:
     """Is the device codec actually usable for this schema?"""
     try:
@@ -695,6 +762,13 @@ def main() -> None:
                                    max(3, args.reps), details)
     except Exception as e:
         _log(f"[bench] deadline overhead measurement failed: {e!r}")
+
+    # OTLP-exporter overhead (ISSUE 16 acceptance: exporting to a local
+    # sink vs exporter-off on the kafka headline stays sub-1%)
+    try:
+        _measure_otlp_overhead(kafka, datums, args.chunks, details)
+    except Exception as e:
+        _log(f"[bench] otlp overhead measurement failed: {e!r}")
 
     def _headline_line():
         if headline is None:

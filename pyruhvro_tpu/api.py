@@ -60,6 +60,7 @@ from .runtime import (
     router,
     sampling,
     telemetry,
+    traceprop,
 )
 from .runtime.deadline import DeadlineExceeded
 from .runtime.chunking import bounds_rows, chunk_bounds
@@ -709,9 +710,9 @@ def _apply_null_policy(batch, entries, base, n, policy, entry):
 
 
 def _proc_decode_task(payload):
-    schema, data, base, on_error = payload
+    schema, data, base, on_error, tp = payload
     with telemetry.worker_scope("pool.worker", rows=len(data),
-                                op="decode") as w:
+                                op="decode", trace_ctx=tp) as w:
         # chaos seam INSIDE the spawned worker (the env-inherited fault
         # spec applies here too): kind=error fails the chunk, kind=exit
         # kills the worker process mid-fan-out
@@ -732,15 +733,16 @@ def _proc_decode_task(payload):
             raise shift_malformed(e, base) from None
     if errs:
         w.payload["quarantine"] = [
-            (q.index + base, q.datum, q.error, q.tier) for q in errs
+            (q.index + base, q.datum, q.error, q.tier, q.trace_id)
+            for q in errs
         ]
     return batch, w.payload
 
 
 def _proc_encode_task(payload):
-    schema, batch, base, on_error = payload
+    schema, batch, base, on_error, tp = payload
     with telemetry.worker_scope("pool.worker", rows=batch.num_rows,
-                                op="encode") as w:
+                                op="encode", trace_ctx=tp) as w:
         faults.fire("pool_worker")
         if on_error == "raise":
             [arr] = serialize_record_batch(batch, schema, 1, backend="host")
@@ -752,7 +754,8 @@ def _proc_encode_task(payload):
             )
     if errs:
         w.payload["quarantine"] = [
-            (q.index + base, q.datum, q.error, q.tier) for q in errs
+            (q.index + base, q.datum, q.error, q.tier, q.trace_id)
+            for q in errs
         ]
     return arr, w.payload
 
@@ -783,6 +786,7 @@ def deserialize_array(
     data: Sequence[bytes], schema: str, *, backend: str = "auto",
     on_error: str = "raise", return_errors: bool = False,
     timeout_s: Optional[float] = None, tenant: Optional[str] = None,
+    trace_ctx=None,
 ) -> pa.RecordBatch:
     """Decode Avro datums into a single RecordBatch
     (≙ ``deserialize_array``, ``src/lib.rs:56-71``).
@@ -812,7 +816,17 @@ def deserialize_array(
     ``tenant``: optional caller identity for memory/heavy-hitter
     attribution — lands on the call span and in the per-(tenant,
     schema) sketch behind ``telemetry mem-report`` (ISSUE 12);
-    untagged calls pool under ``"-"``."""
+    untagged calls pool under ``"-"``.
+
+    ``trace_ctx``: optional distributed-trace parent (ISSUE 16) — a W3C
+    ``traceparent`` string, a :class:`~.runtime.traceprop.TraceContext`,
+    or a ``(trace_id, span_id)`` tuple. The call's root span JOINS that
+    trace (its ``trace_id`` matches, its ``parent_span_id`` is the
+    caller's span) instead of minting a fresh id; omitted, the ambient
+    context (enclosing API call, then ``PYRUHVRO_TPU_TRACEPARENT``)
+    applies, else a new 128-bit trace id is minted. The context rides
+    into process-pool workers, quarantine records and the flight
+    recorder, and out through the OTLP exporter."""
     _check_backend(backend)
     _check_on_error(on_error)
     data = as_datum_input(data)
@@ -820,6 +834,7 @@ def deserialize_array(
     memacct.attribute(tenant, entry.fingerprint, "decode", len(data),
                       data)
     with telemetry.root_span("api.deserialize_array", rows=len(data),
+                             trace_ctx=trace_ctx,
                              backend=backend, schema=entry.fingerprint,
                              **({"tenant": tenant} if tenant else {})), \
             sampling.call_scope("decode", entry.fingerprint,
@@ -882,7 +897,7 @@ def deserialize_array_threaded(
     data: Sequence[bytes], schema: str, num_chunks: int, *,
     backend: str = "auto", on_error: str = "raise",
     return_errors: bool = False, timeout_s: Optional[float] = None,
-    tenant: Optional[str] = None,
+    tenant: Optional[str] = None, trace_ctx=None,
 ) -> List[pa.RecordBatch]:
     """Decode in ``num_chunks`` chunks → one RecordBatch per chunk
     (≙ ``deserialize_array_threaded``, ``src/lib.rs:73-89``).
@@ -893,9 +908,11 @@ def deserialize_array_threaded(
     (``parallel/sharded.py``); on a single chip the whole input is
     decoded in one fused launch and sliced per chunk.
 
-    ``on_error``/``return_errors``/``timeout_s``/``tenant`` and the
-    pyarrow BinaryArray ingestion lane for ``data``: see
-    :func:`deserialize_array`.
+    ``on_error``/``return_errors``/``timeout_s``/``tenant``/
+    ``trace_ctx`` and the pyarrow BinaryArray ingestion lane for
+    ``data``: see :func:`deserialize_array`. On the process-pool arm
+    the trace context ships to every worker, so chunk spans re-parent
+    under the CALLER's trace id.
     Chunk boundaries are computed on the INPUT rows; under ``"skip"``
     a chunk's batch holds its surviving rows (``"null"`` preserves the
     per-chunk row count on all-nullable schemas)."""
@@ -908,6 +925,7 @@ def deserialize_array_threaded(
     bounds = chunk_bounds(len(data), num_chunks)
     with telemetry.root_span("api.deserialize_array_threaded",
                              rows=len(data), chunks=num_chunks,
+                             trace_ctx=trace_ctx,
                              backend=backend, schema=entry.fingerprint,
                              **({"tenant": tenant} if tenant else {})), \
             sampling.call_scope("decode", entry.fingerprint,
@@ -934,12 +952,15 @@ def _deserialize_chunks(dec, entry, data, schema, num_chunks, bounds,
     """The chunked decode body, on the decided (tier, pool) arm."""
     tier, impl = dec.tier, dec.impl
     use_proc = dec.pool == "process"  # router/env picked the spawn pool
+    # the caller's live trace context (the root span is already open),
+    # shipped verbatim so worker chunk spans join the caller's trace
+    tp = traceprop.current_traceparent()
     if on_error == "raise":
         _enforce_max_datum(data)
         if use_proc:
             out = _proc_map(
                 _proc_decode_task,
-                [(schema, list(data[a:b]), a, "raise")
+                [(schema, list(data[a:b]), a, "raise", tp)
                  for a, b in bounds],
                 rows=lambda p: len(p[1]),
             )
@@ -977,7 +998,7 @@ def _deserialize_chunks(dec, entry, data, schema, num_chunks, bounds,
             # (merged into `quar` by telemetry.merge_worker)
             out = _proc_map(
                 _proc_decode_task,
-                [(schema, list(data[a:b]), a, on_error)
+                [(schema, list(data[a:b]), a, on_error, tp)
                  for a, b in bounds],
                 rows=lambda p: len(p[1]),
             )
@@ -1029,13 +1050,14 @@ def deserialize_array_threaded_spawn(
     data: Sequence[bytes], schema: str, num_chunks: int, *,
     backend: str = "auto", on_error: str = "raise",
     return_errors: bool = False, timeout_s: Optional[float] = None,
-    tenant: Optional[str] = None,
+    tenant: Optional[str] = None, trace_ctx=None,
 ) -> List[pa.RecordBatch]:
     """Signature-parity alias of :func:`deserialize_array_threaded`
     (≙ ``src/lib.rs:108-128``; thread-pool flavor is a host-side detail)."""
     return deserialize_array_threaded(
         data, schema, num_chunks, backend=backend, on_error=on_error,
         return_errors=return_errors, timeout_s=timeout_s, tenant=tenant,
+        trace_ctx=trace_ctx,
     )
 
 
@@ -1043,7 +1065,7 @@ def serialize_record_batch(
     batch: pa.RecordBatch, schema: str, num_chunks: int, *,
     backend: str = "auto", on_error: str = "raise",
     return_errors: bool = False, timeout_s: Optional[float] = None,
-    tenant: Optional[str] = None,
+    tenant: Optional[str] = None, trace_ctx=None,
 ) -> List[pa.Array]:
     """Encode a RecordBatch into Avro datums, one BinaryArray per chunk
     (≙ ``serialize_record_batch``, ``src/lib.rs:91-106``).
@@ -1053,7 +1075,8 @@ def serialize_record_batch(
     its fixed size — are dropped and quarantined with ``datum=None``),
     or ``"null"`` (on all-nullable schemas the offending rows encode as
     all-null rows, preserving the row count). Under ``"skip"`` the
-    chunked return re-chunks over the SURVIVING rows."""
+    chunked return re-chunks over the SURVIVING rows.
+    ``trace_ctx``: see :func:`deserialize_array`."""
     _check_backend(backend)
     _check_on_error(on_error)
     entry = get_or_parse_schema(schema)
@@ -1069,6 +1092,7 @@ def serialize_record_batch(
     bounds = chunk_bounds(batch.num_rows, num_chunks)
     with telemetry.root_span("api.serialize_record_batch",
                              rows=batch.num_rows, chunks=num_chunks,
+                             trace_ctx=trace_ctx,
                              backend=backend, schema=entry.fingerprint,
                              **({"tenant": tenant} if tenant else {})), \
             sampling.call_scope("encode", entry.fingerprint,
@@ -1095,11 +1119,12 @@ def _serialize_chunks(dec, entry, batch, schema, num_chunks, bounds,
     """The chunked encode body, on the decided (tier, pool) arm."""
     tier, impl = dec.tier, dec.impl
     use_proc = dec.pool == "process"  # router/env picked the spawn pool
+    tp = traceprop.current_traceparent()  # ships the caller's trace
     if on_error == "raise":
         if use_proc:
             out = _proc_map(
                 _proc_encode_task,
-                [(schema, batch.slice(a, b - a), a, "raise")
+                [(schema, batch.slice(a, b - a), a, "raise", tp)
                  for a, b in bounds],
                 rows=lambda p: p[1].num_rows,
             )
@@ -1136,7 +1161,7 @@ def _serialize_chunks(dec, entry, batch, schema, num_chunks, bounds,
         if use_proc:
             out = _proc_map(
                 _proc_encode_task,
-                [(schema, batch.slice(a, b - a), a, on_error)
+                [(schema, batch.slice(a, b - a), a, on_error, tp)
                  for a, b in bounds],
                 rows=lambda p: p[1].num_rows,
             )
@@ -1171,11 +1196,12 @@ def serialize_record_batch_spawn(
     batch: pa.RecordBatch, schema: str, num_chunks: int, *,
     backend: str = "auto", on_error: str = "raise",
     return_errors: bool = False, timeout_s: Optional[float] = None,
-    tenant: Optional[str] = None,
+    tenant: Optional[str] = None, trace_ctx=None,
 ) -> List[pa.Array]:
     """Signature-parity alias of :func:`serialize_record_batch`
     (≙ ``src/lib.rs:130-147``)."""
     return serialize_record_batch(
         batch, schema, num_chunks, backend=backend, on_error=on_error,
         return_errors=return_errors, timeout_s=timeout_s, tenant=tenant,
+        trace_ctx=trace_ctx,
     )

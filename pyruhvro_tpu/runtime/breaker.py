@@ -261,6 +261,10 @@ _DEFAULTS = {
     "process_pool": (1, 1.0),
     "device_backend": (1, 1.0),
     "native_extract": (2, 1.0),
+    # the OTLP exporter's collector seam: tolerate one failed flush
+    # (collectors restart), then back off — a dead collector costs one
+    # probe per backoff window instead of one timeout per interval
+    "otlp_export": (2, 1.0),
 }
 
 

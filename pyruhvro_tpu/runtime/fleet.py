@@ -1,0 +1,464 @@
+"""Multi-replica snapshot aggregation + snapshot-diff attribution.
+
+The serving plane (ROADMAP item 1) fronts a FLEET of replicas; every
+exporter before this one spoke for a single process. This module makes
+N schema-v3 snapshots one:
+
+* :func:`merge_snapshots` — counters sum, histogram buckets merge (and
+  quantiles recompute from the merged distribution), gauges sum-or-max
+  by their declared kind (:func:`metrics.gauge_kind`: watermarks take
+  the max — peaks summed across replicas describe a process that never
+  existed), routing ledgers and SLO objectives concatenate with replica
+  tags, heavy-hitter sketches fold by (tenant, schema), breakers
+  namespace per replica. The merged document is a regular snapshot:
+  ``report`` / ``prom`` / ``slo-report`` render it unchanged.
+* :func:`fetch_snapshot` — one live ``/snapshot?compress=1`` pull from
+  a replica's obs server (gzip on the wire; stdlib only).
+* :func:`diff_snapshots` / :func:`render_diff` — regression
+  attribution between two snapshots: per-key counter/gauge deltas,
+  per-phase latency shift (p50/p95/p99), new/dead keys and
+  routing-arm mix changes. ``scripts/perf_gate.py`` commits the
+  rendered diff as a CI artifact so a bench regression arrives
+  pre-attributed to a phase.
+"""
+
+from __future__ import annotations
+
+import gzip
+import json
+import urllib.request
+from typing import Any, Dict, List, Optional, Tuple
+
+from . import metrics
+
+__all__ = ["fetch_snapshot", "merge_snapshots", "diff_snapshots",
+           "render_diff"]
+
+_FETCH_TIMEOUT_S = 10.0
+_MAX_SPANS = 64  # same retention as telemetry's live ring
+
+
+# ---------------------------------------------------------------------------
+# live scrape
+# ---------------------------------------------------------------------------
+
+
+def fetch_snapshot(hostport: str) -> Dict[str, Any]:
+    """Pull ``/snapshot?compress=1`` from one replica's obs server.
+    ``hostport`` is ``host:port`` or a full ``http://...`` base URL.
+    Raises OSError/ValueError on unreachable hosts or non-snapshot
+    bodies (the CLI maps both onto its exit-2 contract)."""
+    base = hostport if "://" in hostport else f"http://{hostport}"
+    url = base.rstrip("/") + "/snapshot?compress=1"
+    req = urllib.request.Request(
+        url, headers={"Accept-Encoding": "gzip"})
+    with urllib.request.urlopen(req, timeout=_FETCH_TIMEOUT_S) as r:
+        body = r.read()
+    if body[:2] == b"\x1f\x8b":  # gzip magic
+        body = gzip.decompress(body)
+    doc = json.loads(body.decode("utf-8"))
+    if not isinstance(doc, dict) or not (
+            {"counters", "histograms", "spans"} & set(doc)):
+        raise ValueError(f"{url} did not return a telemetry snapshot")
+    return doc
+
+
+# ---------------------------------------------------------------------------
+# merge
+# ---------------------------------------------------------------------------
+
+
+def _merge_counters(snaps: List[Dict[str, Any]]) -> Dict[str, float]:
+    out: Dict[str, float] = {}
+    for s in snaps:
+        for k, v in (s.get("counters") or {}).items():
+            out[k] = out.get(k, 0.0) + float(v)
+    return out
+
+
+def _merge_gauges(snaps: List[Dict[str, Any]]) -> Dict[str, float]:
+    out: Dict[str, float] = {}
+    for s in snaps:
+        for k, v in (s.get("gauges") or {}).items():
+            v = float(v)
+            if k in out and metrics.gauge_kind(k) == "max":
+                out[k] = max(out[k], v)
+            else:
+                out[k] = out.get(k, 0.0) + v if k in out else v
+    return out
+
+
+def _bucket_counts(summary: Dict[str, Any]) -> Dict[Any, int]:
+    """De-cumulate one histogram summary into per-bucket counts keyed
+    by upper bound (float, or the string ``"+Inf"``)."""
+    counts: Dict[Any, int] = {}
+    prev = 0
+    for le, cum in summary.get("buckets") or []:
+        key = "+Inf" if le == "+Inf" else float(le)
+        counts[key] = counts.get(key, 0) + int(cum) - prev
+        prev = int(cum)
+    return counts
+
+
+def _quantile(sorted_counts: List[Tuple[Any, int]], n: int,
+              q: float) -> float:
+    """Prometheus-style upper-bound quantile over merged non-cumulative
+    bucket counts (ascending; +Inf last)."""
+    if not n:
+        return 0.0
+    target = q * n
+    cum = 0
+    for le, c in sorted_counts:
+        cum += c
+        if c and cum >= target:
+            return float("inf") if le == "+Inf" else float(le)
+    return float("inf")
+
+
+def _merge_hist(summaries: List[Dict[str, Any]]) -> Dict[str, Any]:
+    counts: Dict[Any, int] = {}
+    total = 0
+    sum_s = 0.0
+    exemplar: Optional[Dict[str, Any]] = None
+    for h in summaries:
+        total += int(h.get("count", 0))
+        sum_s += float(h.get("sum", 0.0))
+        for le, c in _bucket_counts(h).items():
+            counts[le] = counts.get(le, 0) + c
+        ex = h.get("exemplar")
+        if ex and (exemplar is None
+                   or float(ex["value"]) > float(exemplar["value"])):
+            exemplar = dict(ex)
+    ordered = sorted(counts.items(),
+                     key=lambda kv: (kv[0] == "+Inf",
+                                     kv[0] if kv[0] != "+Inf" else 0.0))
+    buckets: List[list] = []
+    cum = 0
+    for le, c in ordered:
+        cum += c
+        if c:
+            buckets.append([le, cum])
+    if not buckets or buckets[-1][0] != "+Inf":
+        buckets.append(["+Inf", cum])
+    out: Dict[str, Any] = {
+        "count": total,
+        "sum": sum_s,
+        "p50": _quantile(ordered, total, 0.50),
+        "p95": _quantile(ordered, total, 0.95),
+        "p99": _quantile(ordered, total, 0.99),
+        "buckets": buckets,
+    }
+    if exemplar is not None:
+        out["exemplar"] = exemplar
+    return out
+
+
+def _merge_histograms(snaps: List[Dict[str, Any]]) -> Dict[str, Any]:
+    keys: List[str] = []
+    for s in snaps:
+        for k in (s.get("histograms") or {}):
+            if k not in keys:
+                keys.append(k)
+    return {k: _merge_hist([s["histograms"][k] for s in snaps
+                            if k in (s.get("histograms") or {})])
+            for k in sorted(keys)}
+
+
+def _merge_spans(snaps: List[Dict[str, Any]], tags: List[str]):
+    spans: List[Dict[str, Any]] = []
+    dropped = 0
+    for s, tag in zip(snaps, tags):
+        dropped += int(s.get("spans_dropped") or 0)
+        for sp in s.get("spans") or []:
+            sp = dict(sp)
+            attrs = dict(sp.get("attrs") or {})
+            attrs["replica"] = tag
+            sp["attrs"] = attrs
+            spans.append(sp)
+    spans.sort(key=lambda sp: float(sp.get("ts") or 0.0))
+    if len(spans) > _MAX_SPANS:
+        dropped += len(spans) - _MAX_SPANS
+        spans = spans[-_MAX_SPANS:]
+    return spans, dropped
+
+
+def _merge_routing(snaps: List[Dict[str, Any]],
+                   tags: List[str]) -> Dict[str, Any]:
+    ledger: List[Dict[str, Any]] = []
+    autotune = False
+    ledger_dropped = 0
+    for s, tag in zip(snaps, tags):
+        r = s.get("routing") or {}
+        autotune = autotune or bool(r.get("autotune"))
+        ledger_dropped += int(r.get("ledger_dropped") or 0)
+        for e in r.get("ledger") or []:
+            e = dict(e)
+            e["replica"] = tag
+            ledger.append(e)
+    if not ledger and not ledger_dropped:
+        return {}
+    return {"autotune": autotune, "ledger": ledger,
+            "ledger_dropped": ledger_dropped, "fleet": True}
+
+
+def _merge_slo(snaps: List[Dict[str, Any]],
+               tags: List[str]) -> Dict[str, Any]:
+    objectives: List[Dict[str, Any]] = []
+    breached: List[str] = []
+    files: List[str] = []
+    errors: List[str] = []
+    for s, tag in zip(snaps, tags):
+        sec = s.get("slo")
+        if not isinstance(sec, dict) or not sec:
+            continue
+        f = sec.get("file")
+        if f and f not in files:
+            files.append(f)
+        if sec.get("config_error"):
+            errors.append(f"[{tag}] {sec['config_error']}")
+        for o in sec.get("objectives") or []:
+            o = dict(o)
+            o["replica"] = tag
+            o["name"] = f"[{tag}] {o.get('name')}"
+            objectives.append(o)
+        for name in sec.get("breached") or []:
+            breached.append(f"[{tag}] {name}")
+    if not objectives and not errors:
+        return {}
+    out: Dict[str, Any] = {
+        "file": "; ".join(files),
+        "objectives": objectives,
+        "breached": breached,
+    }
+    if errors:
+        out["config_error"] = "; ".join(errors)
+    return out
+
+
+def _merge_memory(snaps: List[Dict[str, Any]]) -> Dict[str, Any]:
+    sections = [s.get("memory") for s in snaps
+                if isinstance(s.get("memory"), dict)]
+    if not sections:
+        return {}
+    out: Dict[str, Any] = {
+        "rss_bytes": sum(int(m.get("rss_bytes") or 0) for m in sections),
+        "peak_rss_bytes": max(int(m.get("peak_rss_bytes") or 0)
+                              for m in sections),
+        "tracked_bytes": sum(int(m.get("tracked_bytes") or 0)
+                             for m in sections),
+    }
+    caches: Dict[str, Dict[str, Any]] = {}
+    for m in sections:
+        for name, c in (m.get("caches") or {}).items():
+            dst = caches.setdefault(name, {})
+            for k, v in c.items():
+                if isinstance(v, (int, float)) and not isinstance(v, bool):
+                    if "peak" in k or "high_water" in k:
+                        dst[k] = max(dst.get(k, 0), v)
+                    else:
+                        dst[k] = dst.get(k, 0) + v
+                else:
+                    dst.setdefault(k, v)
+    if caches:
+        out["caches"] = {k: caches[k] for k in sorted(caches)}
+    # heavy-hitter fold: the per-replica space-saving sketches combine
+    # by summing per-(tenant, schema) rows — the fleet's top tenants
+    tenants: Dict[Tuple[str, str], Dict[str, Any]] = {}
+    for m in sections:
+        for row in m.get("tenants") or []:
+            key = (str(row.get("tenant")), str(row.get("schema")))
+            dst = tenants.setdefault(
+                key, {"tenant": key[0], "schema": key[1]})
+            for k, v in row.items():
+                if isinstance(v, (int, float)) and not isinstance(v, bool):
+                    dst[k] = dst.get(k, 0) + int(v)
+    if tenants:
+        out["tenants"] = sorted(tenants.values(),
+                                key=lambda r: -r.get("bytes", 0))
+    return out
+
+
+def _merge_breakers(snaps: List[Dict[str, Any]],
+                    tags: List[str]) -> Dict[str, Any]:
+    out: Dict[str, Any] = {}
+    for s, tag in zip(snaps, tags):
+        for name, b in (s.get("breakers") or {}).items():
+            out[f"{tag}:{name}"] = b
+    return out
+
+
+def merge_snapshots(snaps: List[Dict[str, Any]],
+                    tags: Optional[List[str]] = None) -> Dict[str, Any]:
+    """N replica snapshots -> ONE fleet snapshot (still schema v3:
+    every existing renderer takes it unchanged). Counter exactness is
+    the contract CI asserts: every merged counter equals the sum of
+    the per-replica values, bit-for-bit (float addition in input
+    order, no re-normalization)."""
+    if not snaps:
+        raise ValueError("merge_snapshots needs at least one snapshot")
+    if tags is None:
+        tags = [f"r{i}" for i in range(len(snaps))]
+    tags = [str(t) for t in tags] + [
+        f"r{i}" for i in range(len(tags), len(snaps))]
+    spans, dropped = _merge_spans(snaps, tags)
+    out: Dict[str, Any] = {
+        "schema_version": max(
+            [int(s.get("schema_version") or 1) for s in snaps] + [3]),
+        "fleet": {
+            "replicas": [
+                {"tag": tag, "pid": s.get("pid")}
+                for s, tag in zip(snaps, tags)
+            ],
+            "count": len(snaps),
+        },
+        "counters": _merge_counters(snaps),
+        "histograms": _merge_histograms(snaps),
+        "spans": spans,
+        "spans_dropped": dropped,
+        "flight_records": sum(int(s.get("flight_records") or 0)
+                              for s in snaps),
+    }
+    gauges = _merge_gauges(snaps)
+    if gauges:
+        out["gauges"] = gauges
+    routing = _merge_routing(snaps, tags)
+    if routing:
+        out["routing"] = routing
+    slo_sec = _merge_slo(snaps, tags)
+    if slo_sec:
+        out["slo"] = slo_sec
+    mem = _merge_memory(snaps)
+    if mem:
+        out["memory"] = mem
+    brs = _merge_breakers(snaps, tags)
+    if brs:
+        out["breakers"] = brs
+    return out
+
+
+# ---------------------------------------------------------------------------
+# diff (regression attribution)
+# ---------------------------------------------------------------------------
+
+
+def _num_diff(a: Dict[str, float], b: Dict[str, float]):
+    changed: List[list] = []
+    new: Dict[str, float] = {}
+    dead: Dict[str, float] = {}
+    for k in sorted(set(a) | set(b)):
+        va, vb = a.get(k), b.get(k)
+        if va is None:
+            new[k] = float(vb)
+        elif vb is None:
+            dead[k] = float(va)
+        elif float(va) != float(vb):
+            changed.append([k, float(va), float(vb),
+                            float(vb) - float(va)])
+    changed.sort(key=lambda row: -abs(row[3]))
+    return {"changed": changed, "new": new, "dead": dead}
+
+
+def _arm_mix(counters: Dict[str, float]) -> Dict[str, float]:
+    """Routing-arm shares from the flat ``route.<arm>`` counters
+    (one-level keys only: ``route.reason.*`` names causes, not arms)."""
+    arms = {k[len("route."):]: float(v) for k, v in counters.items()
+            if k.startswith("route.") and "." not in k[len("route."):]}
+    total = sum(arms.values())
+    if not total:
+        return {}
+    return {arm: v / total for arm, v in sorted(arms.items())}
+
+
+def diff_snapshots(a: Dict[str, Any],
+                   b: Dict[str, Any]) -> Dict[str, Any]:
+    """The structured regression-attribution document between baseline
+    ``a`` and candidate ``b``."""
+    ca = {k: float(v) for k, v in (a.get("counters") or {}).items()}
+    cb = {k: float(v) for k, v in (b.get("counters") or {}).items()}
+    ga = {k: float(v) for k, v in (a.get("gauges") or {}).items()}
+    gb = {k: float(v) for k, v in (b.get("gauges") or {}).items()}
+    ha = a.get("histograms") or {}
+    hb = b.get("histograms") or {}
+    hists: Dict[str, Any] = {}
+    for k in sorted(set(ha) | set(hb)):
+        xa, xb = ha.get(k), hb.get(k)
+        if xa is None or xb is None:
+            continue  # new/dead keys already surface via counters
+        ent: Dict[str, Any] = {
+            "count": [int(xa.get("count", 0)), int(xb.get("count", 0))],
+        }
+        shifted = False
+        for q in ("p50", "p95", "p99"):
+            qa, qb = float(xa.get(q) or 0.0), float(xb.get(q) or 0.0)
+            ent[q] = [qa, qb]
+            if qa != qb:
+                shifted = True
+        if shifted:
+            hists[k] = ent
+    mix_a, mix_b = _arm_mix(ca), _arm_mix(cb)
+    mix: Dict[str, Any] = {}
+    for arm in sorted(set(mix_a) | set(mix_b)):
+        fa, fb = mix_a.get(arm, 0.0), mix_b.get(arm, 0.0)
+        if abs(fa - fb) > 1e-9:
+            mix[arm] = [fa, fb]
+    return {
+        "counters": _num_diff(ca, cb),
+        "gauges": _num_diff(ga, gb),
+        "histograms": hists,
+        "routing_mix": mix,
+    }
+
+
+def _fmt_q(v: float) -> str:
+    return "inf" if v == float("inf") else f"{v * 1e3:.3f}"
+
+
+def render_diff(a: Dict[str, Any], b: Dict[str, Any],
+                top: int = 20) -> str:
+    """Text report of :func:`diff_snapshots` — what changed, ranked by
+    magnitude, phases first (that is where a bench regression lives)."""
+    d = diff_snapshots(a, b)
+    out: List[str] = ["== snapshot diff (a -> b) =="]
+    hists = d["histograms"]
+    if hists:
+        out += ["", "-- phase latency shift (ms) --"]
+        header = (f"{'phase':<36} {'count a->b':>13} {'p50':>15} "
+                  f"{'p95':>15} {'p99':>15}")
+        out += [header, "-" * len(header)]
+        for k, e in hists.items():
+            out.append(
+                f"{k:<36} {e['count'][0]:>5}->{e['count'][1]:<6} "
+                + " ".join(
+                    f"{_fmt_q(e[q][0]):>7}>{_fmt_q(e[q][1]):<7}"
+                    for q in ("p50", "p95", "p99")))
+    cd = d["counters"]
+    if cd["changed"]:
+        out += ["", f"-- counter deltas (top {top} by |delta|) --"]
+        for k, va, vb, delta in cd["changed"][:top]:
+            out.append(f"{k:<44} {va:>14.6g} -> {vb:<14.6g} "
+                       f"({'+' if delta >= 0 else ''}{delta:.6g})")
+        if len(cd["changed"]) > top:
+            out.append(f"... {len(cd['changed']) - top} more changed")
+    if cd["new"]:
+        out += ["", "-- new counters (absent in a) --"]
+        out += [f"{k:<44} {v:.6g}" for k, v in sorted(cd["new"].items())]
+    if cd["dead"]:
+        out += ["", "-- dead counters (absent in b) --"]
+        out += [f"{k:<44} {v:.6g}" for k, v in sorted(cd["dead"].items())]
+    gd = d["gauges"]
+    if gd["changed"] or gd["new"] or gd["dead"]:
+        out += ["", "-- gauge deltas --"]
+        for k, va, vb, delta in gd["changed"][:top]:
+            out.append(f"{k:<44} {va:>14.6g} -> {vb:<14.6g}")
+        out += [f"{k:<44} (new) {v:.6g}"
+                for k, v in sorted(gd["new"].items())]
+        out += [f"{k:<44} (dead) {v:.6g}"
+                for k, v in sorted(gd["dead"].items())]
+    if d["routing_mix"]:
+        out += ["", "-- routing arm mix --"]
+        for arm, (fa, fb) in d["routing_mix"].items():
+            out.append(f"route.{arm:<20} {fa * 100:>6.1f}% -> "
+                       f"{fb * 100:<6.1f}%")
+    if len(out) == 1:
+        out.append("no differences")
+    return "\n".join(out) + "\n"

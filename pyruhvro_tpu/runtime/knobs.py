@@ -199,6 +199,15 @@ _reg("PYRUHVRO_TPU_HEALTH_WINDOW", "float", 60.0,
 _reg("PYRUHVRO_TPU_SLO_FILE", "str", "",
      "JSON file of latency/error-rate objectives fed to the burn-rate "
      "engine.")
+_reg("PYRUHVRO_TPU_TRACEPARENT", "str", "",
+     "W3C traceparent ingress: root spans with no explicit/inherited "
+     "context join this trace (spawn-pool workers receive it "
+     "automatically).")
+_reg("PYRUHVRO_TPU_OTLP_ENDPOINT", "str", "",
+     "OTLP/HTTP collector base URL (e.g. http://127.0.0.1:4318); "
+     "empty disables the exporter.")
+_reg("PYRUHVRO_TPU_OTLP_INTERVAL_S", "float", 5.0,
+     "OTLP exporter flush interval in seconds.")
 _reg("PYRUHVRO_TPU_SAMPLE_BUDGET", "float", 0.01,
      "Adaptive deep-profiling overhead budget as a wall-time fraction "
      "(<= 0 disables the sampler).")

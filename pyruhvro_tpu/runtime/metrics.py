@@ -28,7 +28,7 @@ from typing import Dict
 
 __all__ = ["inc", "merge", "snapshot", "reset", "timer", "record_deltas",
            "mark", "mark_age", "DeferredCount", "register_flush_hook",
-           "set_gauge", "gauges"]
+           "set_gauge", "gauges", "declare_gauge_kind", "gauge_kind"]
 
 _lock = threading.Lock()
 _counters: Dict[str, float] = defaultdict(float)  # guarded-by: _lock
@@ -94,6 +94,37 @@ def gauges() -> Dict[str, float]:
     """A copy of every gauge's current value."""
     with _lock:
         return dict(_gauges)
+
+
+# fleet-merge semantics per gauge family (ISSUE 16): when N replicas'
+# snapshots merge, most gauges SUM (total cache bytes across the fleet
+# is the capacity fact an operator wants) but watermark-shaped gauges
+# must take the MAX — peaks summed across replicas describe a process
+# that never existed. Declared by key prefix; longest match wins.
+# Static defaults cover the known watermark families so an OFFLINE
+# merge (the fleet CLI over saved files) agrees with a live one.
+_GAUGE_MAX_PREFIXES = {"mem.peak_", "mem.high_water"}  # guarded-by: _lock
+
+
+def declare_gauge_kind(prefix: str, kind: str = "sum") -> None:
+    """Declare how gauges under ``prefix`` merge across replicas:
+    ``"sum"`` (the default for undeclared keys) or ``"max"`` for
+    watermarks/high-water facts."""
+    assert kind in ("sum", "max"), kind
+    with _lock:
+        if kind == "max":
+            _GAUGE_MAX_PREFIXES.add(prefix)
+        else:
+            _GAUGE_MAX_PREFIXES.discard(prefix)
+
+
+def gauge_kind(key: str) -> str:
+    """The declared fleet-merge kind for one gauge key."""
+    with _lock:
+        for p in _GAUGE_MAX_PREFIXES:
+            if key.startswith(p):
+                return "max"
+    return "sum"
 
 
 def mark(key: str) -> None:

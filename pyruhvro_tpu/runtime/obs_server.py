@@ -9,11 +9,14 @@ telemetry registry per request:
 * ``GET /metrics`` — Prometheus text exposition, byte-identical to
   ``telemetry.prometheus()`` on the same registry state (it IS the same
   function), so existing scrape configs/dashboards keep working;
+  ``?exemplars=1`` opts OpenMetrics-aware collectors into trace-id
+  exemplars on the latency histograms;
 * ``GET /healthz`` — readiness + degradation bits as JSON, HTTP 200
   when serviceable, 503 while an active storm / SLO breach / latency
   drift makes the process unhealthy (see :func:`health`);
 * ``GET /snapshot`` — the full ``telemetry.snapshot()``
-  (schema_version 2) as JSON;
+  (schema_version 2) as JSON; ``?compress=1`` gzips the body (what
+  ``telemetry fleet --scrape`` pulls from each replica);
 * ``GET /flight`` — the flight recorder ring (``telemetry.flight_dump()``);
 * ``GET /memory`` — the live memory accounting section
   (``memacct.snapshot_memory()``: RSS, per-cache footprints, lifecycle
@@ -33,9 +36,11 @@ be pointed at the same dashboards.
 
 from __future__ import annotations
 
+import gzip
 import json
 import os
 import threading
+import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, Optional, Tuple
 
@@ -174,7 +179,14 @@ class _Handler(BaseHTTPRequestHandler):
                    "application/json")
 
     def do_GET(self):  # noqa: N802 — BaseHTTPRequestHandler contract
-        path = self.path.split("?", 1)[0].rstrip("/") or "/"
+        path, _, query = self.path.partition("?")
+        path = path.rstrip("/") or "/"
+        params = urllib.parse.parse_qs(query)
+
+        def flag(name: str) -> bool:
+            v = params.get(name, [""])[-1].strip().lower()
+            return v not in ("", "0", "false", "no", "off")
+
         snap_doc = self.server._static_snapshot  # type: ignore[attr-defined]
         try:
             metrics.inc("obs.requests")
@@ -184,7 +196,11 @@ class _Handler(BaseHTTPRequestHandler):
             if path == "/metrics":
                 from . import telemetry
 
-                text = telemetry.prometheus(snap_doc)  # None = live
+                # plain scrapes stay BYTE-IDENTICAL to
+                # telemetry.prometheus(); ?exemplars=1 opts an
+                # OpenMetrics-aware collector into exemplar syntax
+                text = telemetry.prometheus(
+                    snap_doc, exemplars=flag("exemplars"))  # None = live
                 self._send(200, text.encode(),
                            "text/plain; version=0.0.4; charset=utf-8")
             elif path == "/healthz":
@@ -195,11 +211,24 @@ class _Handler(BaseHTTPRequestHandler):
                 self._send_json(code, body)
             elif path == "/snapshot":
                 if snap_doc is not None:
-                    self._send_json(200, snap_doc)
+                    doc = snap_doc
                 else:
                     from . import telemetry
 
-                    self._send_json(200, telemetry.snapshot())
+                    doc = telemetry.snapshot()
+                if flag("compress"):
+                    # ?compress=1 (the fleet scraper): gzip on the wire
+                    # makes a 3-replica pull cheap over a WAN
+                    body = gzip.compress(
+                        json.dumps(doc, indent=1, default=str).encode())
+                    self.send_response(200)
+                    self.send_header("Content-Type", "application/json")
+                    self.send_header("Content-Encoding", "gzip")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                else:
+                    self._send_json(200, doc)
             elif path == "/flight":
                 if snap_doc is not None:
                     self._send_json(200, {

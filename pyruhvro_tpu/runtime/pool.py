@@ -42,7 +42,7 @@ import time
 from concurrent.futures import ProcessPoolExecutor, ThreadPoolExecutor
 from typing import Callable, List, Optional, Sequence
 
-from . import breaker, deadline, knobs, metrics, telemetry
+from . import breaker, deadline, knobs, metrics, telemetry, traceprop
 
 __all__ = ["get_pool", "map_chunks", "get_process_pool", "map_chunks_proc",
            "pool_mode", "process_available", "fanout_stats"]
@@ -300,6 +300,12 @@ def map_chunks_proc(task: Callable, payloads: Sequence,
         with fanout_stats(len(payloads), pool="process") as stats:
             chaos_env = {k: os.environ.get(k, "")
                          for k in _CHAOS_ENV_KEYS}
+            # trace ingress for the workers (ISSUE 16): the caller's
+            # live context beats whatever the parent env said at spawn
+            # time, so worker root spans without an explicit payload
+            # context still join the caller's trace
+            chaos_env["PYRUHVRO_TPU_TRACEPARENT"] = (
+                traceprop.current_traceparent() or "")
             futures = [get_process_pool().submit(
                            _run_with_chaos_env, task, chaos_env, p)
                        for p in payloads]

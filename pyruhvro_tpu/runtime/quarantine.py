@@ -46,6 +46,10 @@ class QuarantinedRecord(NamedTuple):
     datum: Optional[bytes]  # raw wire bytes (None for encode-side rows)
     error: str            # short slug, e.g. "overrun", "bad_branch"
     tier: str             # "fallback" | "native" | "device" | "policy"
+    # W3C trace id of the call that dead-lettered the row (ISSUE 16):
+    # one poison message stays traceable ingress -> dead-letter across
+    # replicas. Defaulted so pre-trace 4-tuples still reconstruct.
+    trace_id: Optional[str] = None
 
 
 _tls = threading.local()
@@ -121,8 +125,15 @@ def publish(entries: List[QuarantinedRecord], policy: str,
     a flight-recorder dump behind on a quarantine storm
     (>= PYRUHVRO_TPU_QUARANTINE_STORM rows, default 100, when
     PYRUHVRO_TPU_FLIGHT_DIR is configured)."""
-    from . import telemetry
+    from . import telemetry, traceprop
 
+    ctx = traceprop.current()
+    if ctx is not None:
+        # stamp the active trace id onto locally-detected entries
+        # (worker-shipped ones were stamped in the worker, under the
+        # context the pool delivered there)
+        entries[:] = [e if e.trace_id else e._replace(trace_id=ctx.trace_id)
+                      for e in entries]
     entries.sort(key=lambda e: e.index)
     set_last(entries)
     telemetry.annotate(on_error=policy, quarantined=len(entries))

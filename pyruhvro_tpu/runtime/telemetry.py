@@ -59,7 +59,7 @@ from bisect import bisect_left
 from collections import deque
 from typing import Any, Dict, List, Optional
 
-from . import knobs, metrics, slo
+from . import knobs, metrics, slo, traceprop
 
 __all__ = [
     "SNAPSHOT_SCHEMA_VERSION",
@@ -84,6 +84,8 @@ __all__ = [
     "merge_worker",
     "flight_dump",
     "install_flight_signal",
+    "set_span_sink",
+    "hist_summaries",
 ]
 
 # fixed log-spaced latency buckets, 1 µs … 500 s (~3/decade); +Inf is
@@ -121,19 +123,30 @@ _tls = threading.local()
 
 
 class _Hist:
-    """Fixed-bucket latency histogram (counts per bucket + sum)."""
+    """Fixed-bucket latency histogram (counts per bucket + sum).
 
-    __slots__ = ("counts", "n", "sum")
+    Each histogram also keeps ONE exemplar — the trace id of the
+    worst (largest-value) traced observation — so a p99 spike on a
+    fleet dashboard links straight to the trace that caused it
+    (OpenMetrics exemplar syntax / OTLP exemplars)."""
+
+    __slots__ = ("counts", "n", "sum", "ex_value", "ex_trace")
 
     def __init__(self):
         self.counts = [0] * (len(_BUCKET_BOUNDS) + 1)
         self.n = 0
         self.sum = 0.0
+        self.ex_value = 0.0
+        self.ex_trace: Optional[str] = None
 
-    def observe(self, v: float) -> None:
+    def observe(self, v: float, trace_id: Optional[str] = None) -> None:
         self.counts[bisect_left(_BUCKET_BOUNDS, v)] += 1
         self.n += 1
         self.sum += v
+        if trace_id is not None and (self.ex_trace is None
+                                     or v > self.ex_value):
+            self.ex_value = v
+            self.ex_trace = trace_id
 
     def quantile(self, q: float) -> float:
         """Upper bucket bound holding the q-quantile (Prometheus-style)."""
@@ -159,7 +172,7 @@ class _Hist:
                 buckets.append([le, cum])
         if not buckets or buckets[-1][0] != "+Inf":
             buckets.append(["+Inf", cum])
-        return {
+        out = {
             "count": self.n,
             "sum": self.sum,
             "p50": self.quantile(0.50),
@@ -167,6 +180,10 @@ class _Hist:
             "p99": self.quantile(0.99),
             "buckets": buckets,  # cumulative [le, n], zero buckets elided
         }
+        if self.ex_trace is not None:
+            out["exemplar"] = {"value": self.ex_value,
+                               "trace_id": self.ex_trace}
+        return out
 
 
 def _hist_locked(key: str) -> _Hist:
@@ -183,10 +200,16 @@ def _hist_locked(key: str) -> _Hist:
 
 
 class Span:
-    """One timed node of a call tree (root = public API call)."""
+    """One timed node of a call tree (root = public API call).
+
+    Roots additionally carry W3C trace identity (:mod:`.traceprop`):
+    a 128-bit ``trace_id``, this span's own 64-bit ``span_id`` and —
+    when the call joined an existing trace — the caller's
+    ``parent_span_id``. Child phases inherit the root's trace
+    implicitly (they serialize inside its tree)."""
 
     __slots__ = ("name", "attrs", "children", "dur_s", "ts", "_t0",
-                 "parent")
+                 "parent", "trace_id", "span_id", "parent_span_id")
 
     def __init__(self, name: str, attrs: Dict[str, Any]):
         self.name = name
@@ -197,6 +220,9 @@ class Span:
         self._t0 = time.perf_counter()
         # up-link for annotate_root (not serialized; to_dict walks down)
         self.parent: Optional["Span"] = None
+        self.trace_id: Optional[str] = None
+        self.span_id: Optional[str] = None
+        self.parent_span_id: Optional[str] = None
 
     def to_dict(self) -> Dict[str, Any]:
         d: Dict[str, Any] = {
@@ -205,6 +231,11 @@ class Span:
             "dur_s": self.dur_s,
             "attrs": dict(self.attrs),
         }
+        if self.trace_id is not None:
+            d["trace_id"] = self.trace_id
+            d["span_id"] = self.span_id
+            if self.parent_span_id is not None:
+                d["parent_span_id"] = self.parent_span_id
         if self.children:
             d["children"] = [c.to_dict() for c in self.children]
         return d
@@ -220,9 +251,12 @@ class attach:
 
     The pool workers use it so chunk child spans parent under the
     CALLING thread's root span instead of getting lost (the worker
-    thread has no span context of its own)."""
+    thread has no span context of its own). The caller's TRACE
+    context rides along: the adopted span's root carries the trace
+    id, so anything the chunk quarantines or re-enters stays in the
+    caller's trace instead of minting a fresh one per pool thread."""
 
-    __slots__ = ("span", "_prev")
+    __slots__ = ("span", "_prev", "_tp")
 
     def __init__(self, span: Optional[Span]):
         self.span = span
@@ -230,9 +264,18 @@ class attach:
     def __enter__(self):
         self._prev = getattr(_tls, "span", None)
         _tls.span = self.span
+        root = self.span
+        while root is not None and root.parent is not None:
+            root = root.parent
+        ctx = None
+        if root is not None and root.trace_id is not None:
+            ctx = traceprop.TraceContext(root.trace_id, root.span_id)
+        self._tp = traceprop.activate(ctx)
+        self._tp.__enter__()
         return self.span
 
     def __exit__(self, *exc):
+        self._tp.__exit__(*exc)
         _tls.span = self._prev
         return False
 
@@ -243,12 +286,20 @@ class root_span:
     Disabled mode is a no-op (the flat counters the call sites feed via
     :class:`phase`/:func:`observe` still flow). A root opened while
     another is active on the thread (nested API use) attaches as a child
-    of the outer one and is not separately retained."""
+    of the outer one and is not separately retained.
 
-    __slots__ = ("span", "_prev")
+    Trace identity (:mod:`.traceprop`): the root joins the context
+    resolved from ``trace_ctx=`` > thread-local > the
+    ``PYRUHVRO_TPU_TRACEPARENT`` env ingress, minting a fresh 128-bit
+    trace id when none exists; its own context is pushed thread-local
+    for the duration so nested calls, pool chunks and quarantine
+    records all land in the same trace."""
 
-    def __init__(self, name: str, **attrs):
+    __slots__ = ("span", "_prev", "_trace_ctx", "_tp")
+
+    def __init__(self, name: str, trace_ctx=None, **attrs):
         self.span = Span(name, attrs) if _enabled else None
+        self._trace_ctx = trace_ctx
 
     def __enter__(self):
         s = self.span
@@ -259,6 +310,16 @@ class root_span:
             with _lock:
                 self._prev.children.append(s)
             s.parent = self._prev
+        ctx = traceprop.resolve(self._trace_ctx)
+        s.span_id = traceprop.new_span_id()
+        if ctx is not None:
+            s.trace_id = ctx.trace_id
+            s.parent_span_id = ctx.span_id
+        else:
+            s.trace_id = traceprop.new_trace_id()
+        self._tp = traceprop.activate(
+            traceprop.TraceContext(s.trace_id, s.span_id))
+        self._tp.__enter__()
         _tls.span = s
         return s
 
@@ -270,15 +331,23 @@ class root_span:
         if exc_type is not None:
             s.attrs["error"] = exc_type.__name__
         _tls.span = self._prev
+        self._tp.__exit__(exc_type, exc, tb)
         metrics.inc(s.name + "_s", s.dur_s)
         global _roots_seen
         with _lock:
-            _hist_locked(s.name + "_s").observe(s.dur_s)
+            _hist_locked(s.name + "_s").observe(s.dur_s, s.trace_id)
             if self._prev is None:
                 _spans.append(s)
                 _flight.append(_flight_record(s))
                 _roots_seen += 1
         if self._prev is None:
+            sink = _span_sink
+            if sink is not None:
+                try:
+                    sink(s)
+                except Exception:
+                    # a broken exporter must never fail the call
+                    metrics.inc("otlp.sink_error")
             _maybe_trace(s)
             # SLO accounting (runtime/slo.py): every finished API root
             # call is one good/bad/errored event against any matching
@@ -335,8 +404,10 @@ class phase:
                 self.span.attrs["error"] = exc_type.__name__
             _tls.span = self._prev
         if _enabled:
+            ctx = traceprop.current()
             with _lock:
-                _hist_locked(self.key).observe(dt)
+                _hist_locked(self.key).observe(
+                    dt, ctx.trace_id if ctx else None)
         return False
 
 
@@ -350,8 +421,9 @@ def observe(key: str, seconds: float, **attrs) -> None:
     if not _enabled:
         return
     parent = getattr(_tls, "span", None)
+    ctx = traceprop.current()
     with _lock:
-        _hist_locked(key).observe(seconds)
+        _hist_locked(key).observe(seconds, ctx.trace_id if ctx else None)
         if parent is not None:
             s = Span(key, attrs)
             # the interval ENDED at creation: shift ts back so the span's
@@ -438,13 +510,21 @@ def _flight_record(s: Span) -> Dict[str, Any]:
             walk(c)
 
     walk(s)
-    return {
+    rec = {
         "ts": round(s.ts, 6),
+        # paired monotonic clock (perf_counter at span open): epoch ts
+        # alone cannot time-align dumps across replicas whose wall
+        # clocks drift — the pair lets the fleet view re-anchor each
+        # replica's records (and gives Perfetto real track offsets)
+        "mono": round(s._t0, 6),
         "name": s.name,
         "dur_s": s.dur_s,
         "attrs": dict(s.attrs),
         "phases": phases,
     }
+    if s.trace_id is not None:
+        rec["trace_id"] = s.trace_id
+    return rec
 
 
 def _flight_records(blocking: bool = True) -> List[Dict[str, Any]]:
@@ -630,7 +710,6 @@ if knobs.get_raw("PYRUHVRO_TPU_OBS_PORT"):
 
     _obs_server.start_from_env()
 
-
 # memory accounting (ISSUE 12): the span/flight rings are themselves
 # long-lived state — account them like every other ring (per-record
 # size is an explicit estimate; the rings are bounded by construction)
@@ -671,12 +750,16 @@ class worker_scope:
     and spans would otherwise be silently dropped with the worker."""
 
     __slots__ = ("name", "attrs", "payload", "_rec", "_delta", "_root",
-                 "_robs")
+                 "_robs", "_trace_ctx")
 
-    def __init__(self, name: str = "pool.worker", **attrs):
+    def __init__(self, name: str = "pool.worker", trace_ctx=None, **attrs):
         self.name = name
         self.attrs = attrs
         self.payload: Optional[Dict[str, Any]] = None
+        # the caller's shipped trace context (W3C traceparent string or
+        # TraceContext): the worker's root span re-parents under the
+        # REAL trace id instead of minting a synthetic per-pid root
+        self._trace_ctx = trace_ctx
 
     def __enter__(self) -> "worker_scope":
         from . import costmodel
@@ -688,7 +771,8 @@ class worker_scope:
         # parent's model learns from work done in other processes
         self._robs = costmodel.record_observations()
         self._robs.__enter__()
-        self._root = root_span(self.name, pid=os.getpid(), **self.attrs)
+        self._root = root_span(self.name, trace_ctx=self._trace_ctx,
+                               pid=os.getpid(), **self.attrs)
         self._root.__enter__()
         return self
 
@@ -714,6 +798,9 @@ def _span_from_dict(d: Dict[str, Any]) -> Span:
     if ts is not None:
         s.ts = ts
     s.dur_s = d.get("dur_s")
+    s.trace_id = d.get("trace_id")
+    s.span_id = d.get("span_id")
+    s.parent_span_id = d.get("parent_span_id")
     s.children = [_span_from_dict(c) for c in d.get("children") or []]
     return s
 
@@ -759,6 +846,28 @@ def merge_worker(payload: Dict[str, Any], *, counters: bool = True) -> None:
             s = _span_from_dict(sd)
             with _lock:
                 parent.children.append(s)
+
+
+# finished-ROOT-span hook (runtime/otel.py registers its bounded-queue
+# enqueue here): one callable, invoked outside the telemetry lock, and
+# any exception it raises is swallowed + counted — a broken exporter can
+# never fail the data-plane call it observes.
+# lock-free-ok(single GIL-atomic store; readers tolerate staleness)
+_span_sink = None
+
+
+def set_span_sink(fn) -> None:
+    """Register (or clear, with None) the finished-root-span hook."""
+    global _span_sink
+    _span_sink = fn
+
+
+def hist_summaries() -> Dict[str, Any]:
+    """Histogram summaries only — the cheap read the OTLP exporter
+    polls on its flush interval (a full :func:`snapshot` runs the
+    memory probes and device registries every time)."""
+    with _lock:
+        return {k: h.summary() for k, h in sorted(_hists.items())}
 
 
 def set_enabled(flag: bool) -> None:
@@ -885,12 +994,21 @@ def _prom_name(key: str) -> str:
     return "pyruhvro_tpu_" + name
 
 
-def prometheus(snap: Optional[Dict[str, Any]] = None) -> str:
+def prometheus(snap: Optional[Dict[str, Any]] = None, *,
+               exemplars: bool = False) -> str:
     """Prometheus text exposition of a snapshot (default: live state).
 
     Counters export as ``*_total`` counters (keys ending ``_s`` as
     ``*_seconds_total``); histograms as ``_bucket``/``_sum``/``_count``
-    families with the fixed bucket bounds."""
+    families with the fixed bucket bounds.
+
+    ``exemplars=True`` appends OpenMetrics exemplar syntax
+    (``... # {trace_id="..."} value``) to the bucket holding each
+    histogram's worst traced call. OFF by default: plain Prometheus
+    scrapers reject exemplar syntax on a ``text/plain`` exposition, and
+    the ``/metrics`` contract is byte-identical to this function's
+    default output — opt in via ``/metrics?exemplars=1`` or ``prom
+    --exemplars`` for OpenMetrics-aware collectors."""
     if snap is None:
         snap = snapshot()
     lines: List[str] = []
@@ -910,13 +1028,23 @@ def prometheus(snap: Optional[Dict[str, Any]] = None) -> str:
         name = _prom_name(key)
         lines.append(f"# HELP {name} pyruhvro_tpu latency histogram {key}")
         lines.append(f"# TYPE {name} histogram")
+        ex = h.get("exemplar") if exemplars else None
+        ex_done = False
         seen_inf = False
         for le, cum in h.get("buckets", []):
             if le == "+Inf":
                 seen_inf = True
-                lines.append(f'{name}_bucket{{le="+Inf"}} {cum}')
+                line = f'{name}_bucket{{le="+Inf"}} {cum}'
             else:
-                lines.append(f'{name}_bucket{{le="{float(le)!r}"}} {cum}')
+                line = f'{name}_bucket{{le="{float(le)!r}"}} {cum}'
+            if ex and not ex_done and (
+                    le == "+Inf" or ex["value"] <= float(le)):
+                # OpenMetrics exemplar: the worst traced call, attached
+                # to the first bucket that contains it
+                line += (f' # {{trace_id="{ex["trace_id"]}"}} '
+                         f'{float(ex["value"])!r}')
+                ex_done = True
+            lines.append(line)
         if not seen_inf:
             lines.append(f'{name}_bucket{{le="+Inf"}} {h["count"]}')
         lines.append(f"{name}_sum {float(h['sum'])!r}")
@@ -1265,6 +1393,14 @@ def render_report(data: Dict[str, Any]) -> str:
                 f"{sov.get('deep_calls')} deep call(s)) -> "
                 f"{'ok' if sov.get('within_budget') else 'OVER BUDGET'}"
             )
+        oov = data.get("otlp_overhead")
+        if oov:
+            out.append(
+                f"otlp-export overhead on {oov.get('workload', '?')}: "
+                f"{oov.get('overhead_frac', 0) * 100:.2f}% vs budget "
+                f"{(oov.get('budget') or 0) * 100:.2f}% -> "
+                f"{'ok' if oov.get('within_budget') else 'OVER BUDGET'}"
+            )
     else:  # telemetry snapshot
         counters = data.get("counters", {})
         hists = data.get("histograms", {})
@@ -1369,7 +1505,10 @@ def main(argv: Optional[List[str]] = None) -> int:
     different arm would have won) / ``slo-report <file>`` (objectives,
     burn rates, breach state) / ``mem-report <file>`` (memory
     accounting: RSS vs tracked footprints, evictions, heavy hitters) /
-    ``serve <file> [--port N]`` (serve a saved snapshot over HTTP).
+    ``serve <file> [--port N]`` (serve a saved snapshot over HTTP) /
+    ``fleet <snap...|--scrape host:port...>`` (merge N replicas'
+    snapshots into one fleet snapshot) / ``diff <a> <b>`` (regression
+    attribution between two snapshots).
     ``<file>`` is a saved :func:`snapshot` JSON or, for ``report``, a
     ``BENCH_DETAILS.json``."""
     import argparse
@@ -1386,6 +1525,10 @@ def main(argv: Optional[List[str]] = None) -> int:
     p_prom = sub.add_parser(
         "prom", help="Prometheus text format from a snapshot JSON")
     p_prom.add_argument("path")
+    p_prom.add_argument("--exemplars", action="store_true",
+                        help="append OpenMetrics exemplars (worst "
+                             "traced call per histogram) — for "
+                             "OpenMetrics-aware collectors only")
     p_perf = sub.add_parser(
         "perfetto", help="Chrome trace-event JSON (load in "
                          "ui.perfetto.dev) from a snapshot JSON")
@@ -1425,6 +1568,38 @@ def main(argv: Optional[List[str]] = None) -> int:
     p_knobs.add_argument("--markdown", action="store_true",
                          help="emit the README markdown table instead "
                               "of the plain-text listing")
+    p_fleet = sub.add_parser(
+        "fleet", help="merge N replicas' snapshot JSONs (or live "
+                      "--scrape host:port pulls) into ONE fleet "
+                      "snapshot: counters sum, histogram buckets "
+                      "merge, gauges sum-or-max by kind, routing "
+                      "ledgers and SLO objectives concatenate with "
+                      "replica tags")
+    p_fleet.add_argument("paths", nargs="*",
+                         help="saved snapshot JSON files, one per "
+                              "replica")
+    p_fleet.add_argument("--scrape", action="append", default=[],
+                         metavar="HOST:PORT",
+                         help="pull a live /snapshot from this obs "
+                              "server (repeatable)")
+    p_fleet.add_argument("--tag", action="append", default=[],
+                         help="replica tag for the matching source, in "
+                              "order (default: file basename / "
+                              "host:port)")
+    p_fleet.add_argument("-o", "--out",
+                         help="write the merged snapshot here instead "
+                              "of stdout (render it with report / prom "
+                              "/ slo-report)")
+    p_diff = sub.add_parser(
+        "diff", help="regression attribution between two snapshots: "
+                     "per-key counter/gauge deltas, per-phase latency "
+                     "shift (p50/p95/p99), new/dead keys, routing-arm "
+                     "mix changes")
+    p_diff.add_argument("a", help="baseline snapshot JSON")
+    p_diff.add_argument("b", help="candidate snapshot JSON")
+    p_diff.add_argument("--json", action="store_true",
+                        help="emit the structured diff document "
+                             "instead of the text report")
     args = ap.parse_args(argv)
 
     if args.cmd == "knobs":
@@ -1442,6 +1617,80 @@ def main(argv: Optional[List[str]] = None) -> int:
               "telemetry.snapshot() (or, for 'report', a "
               "BENCH_DETAILS.json)", file=sys.stderr)
         return 2
+
+    def _load_snapshot(path: str):
+        """A parsed snapshot dict, or an int exit code (2)."""
+        try:
+            with open(path, encoding="utf-8") as f:
+                doc = json.load(f)
+        except OSError as e:
+            return _usage_error(f"cannot read {path}: {e}")
+        except ValueError as e:
+            return _usage_error(f"{path} is not valid JSON: {e}")
+        if not isinstance(doc, dict):
+            return _usage_error(
+                f"{path} holds a JSON {type(doc).__name__}, not a "
+                "snapshot object")
+        if not ({"counters", "histograms", "spans"} & set(doc)):
+            return _usage_error(
+                f"{path} is not a telemetry snapshot (expected "
+                "'counters'/'histograms'/'spans' keys)")
+        return doc
+
+    if args.cmd == "fleet":
+        from . import fleet as _fleet
+
+        if not args.paths and not args.scrape:
+            return _usage_error(
+                "fleet needs at least one snapshot file or --scrape "
+                "host:port")
+        snaps: List[Dict[str, Any]] = []
+        tags: List[str] = []
+        for i, path in enumerate(args.paths):
+            doc = _load_snapshot(path)
+            if isinstance(doc, int):
+                return doc
+            snaps.append(doc)
+            tags.append(args.tag[i] if i < len(args.tag)
+                        else os.path.basename(path))
+        for j, hostport in enumerate(args.scrape):
+            try:
+                doc = _fleet.fetch_snapshot(hostport)
+            except (OSError, ValueError) as e:
+                return _usage_error(
+                    f"cannot scrape {hostport}: {e}")
+            snaps.append(doc)
+            k = len(args.paths) + j
+            tags.append(args.tag[k] if k < len(args.tag) else hostport)
+        merged = _fleet.merge_snapshots(snaps, tags)
+        if args.out:
+            from . import fsio
+
+            fsio.atomic_write_json(args.out, merged)
+            print(f"merged {len(snaps)} replica snapshot(s) -> "
+                  f"{args.out} (render with report / prom / "
+                  "slo-report)", file=sys.stderr)
+        else:
+            json.dump(merged, sys.stdout, indent=1, default=str)
+            sys.stdout.write("\n")
+        return 0
+
+    if args.cmd == "diff":
+        from . import fleet as _fleet
+
+        a = _load_snapshot(args.a)
+        if isinstance(a, int):
+            return a
+        b = _load_snapshot(args.b)
+        if isinstance(b, int):
+            return b
+        if args.json:
+            json.dump(_fleet.diff_snapshots(a, b), sys.stdout,
+                      indent=1, default=str)
+            sys.stdout.write("\n")
+        else:
+            sys.stdout.write(_fleet.render_diff(a, b))
+        return 0
 
     try:
         with open(args.path, encoding="utf-8") as f:
@@ -1531,5 +1780,17 @@ def main(argv: Optional[List[str]] = None) -> int:
             return _usage_error(
                 "not a telemetry snapshot (expected 'counters'/"
                 "'histograms' keys)")
-        sys.stdout.write(prometheus(data))
+        sys.stdout.write(prometheus(
+            data, exemplars=getattr(args, "exemplars", False)))
     return 0
+
+
+# OTLP/HTTP export (runtime/otel.py): opt-in via
+# PYRUHVRO_TPU_OTLP_ENDPOINT, started once at import so a service ships
+# spans + metrics to a collector without any code change. Last in the
+# module: otel's start() registers the span sink defined above, so the
+# hook must run only once this module is fully initialized.
+if knobs.get_raw("PYRUHVRO_TPU_OTLP_ENDPOINT"):
+    from . import otel as _otel
+
+    _otel.start_from_env()

@@ -45,6 +45,14 @@ sys.path.insert(0, REPO)
 DEFAULT_BASELINE = os.path.join(REPO, "PERF_BASELINE.json")
 DEFAULT_TRAJECTORY = os.path.join(REPO, "BENCH_TRAJECTORY.jsonl")
 DEFAULT_SNAPSHOT = os.path.join(REPO, "telemetry_snapshot.json")
+# the committed telemetry snapshot a gate run's snapshot is diffed
+# against (ISSUE 16): the rendered attribution report
+# (telemetry_diff.txt) rides along as a CI artifact, so a red gate
+# arrives with "which phase moved, which counters appeared" already
+# answered. Reseed alongside the baseline with --update-baseline.
+DEFAULT_DIFF_REFERENCE = os.path.join(
+    REPO, "tests", "data", "perf_gate_reference_snapshot.json")
+DEFAULT_DIFF_OUT = os.path.join(REPO, "telemetry_diff.txt")
 DEFAULT_TOLERANCE = 0.15
 
 
@@ -214,6 +222,26 @@ def save_snapshot(path: str) -> None:
 
     fsio.atomic_write_json(path, telemetry.snapshot())
     _log(f"[perf-gate] telemetry snapshot -> {path}")
+
+
+def save_diff(reference_path: str, out_path: str) -> None:
+    """Regression attribution (ISSUE 16): render ``telemetry diff``
+    between the committed reference snapshot and THIS run's telemetry —
+    per-key counter deltas, per-phase p50/p95/p99 latency shift,
+    new/dead keys, routing-arm mix — into a plain-text CI artifact.
+    Advisory: the diff explains the wall-clock verdict, it never makes
+    one (reference counters are machine/config-dependent)."""
+    from pyruhvro_tpu.runtime import fleet, telemetry
+
+    with open(reference_path, encoding="utf-8") as f:
+        reference = json.load(f)
+    text = fleet.render_diff(reference, telemetry.snapshot())
+    tmp = f"{out_path}.tmp{os.getpid()}"
+    with open(tmp, "w", encoding="utf-8") as f:
+        f.write(text + "\n")
+    os.replace(tmp, out_path)
+    _log(f"[perf-gate] telemetry diff (vs {os.path.basename(reference_path)})"
+         f" -> {out_path}")
 
 
 def _device_counters() -> Dict[str, float]:
@@ -559,6 +587,11 @@ def main(argv: Optional[list] = None) -> int:
     ap.add_argument("--no-trajectory", dest="trajectory",
                     action="store_const", const=None)
     ap.add_argument("--snapshot-out", default=DEFAULT_SNAPSHOT)
+    ap.add_argument("--diff-reference", default=DEFAULT_DIFF_REFERENCE,
+                    help="committed snapshot to attribute this run "
+                         "against via 'telemetry diff' (missing file = "
+                         "diff silently skipped)")
+    ap.add_argument("--diff-out", default=DEFAULT_DIFF_OUT)
     ap.add_argument("--update-baseline", action="store_true",
                     help="reseed the baseline from this run and exit 0")
     ap.add_argument("--route-matrix", action="store_true",
@@ -641,6 +674,12 @@ def main(argv: Optional[list] = None) -> int:
                 save_snapshot(args.snapshot_out)
             except Exception as e:  # noqa: BLE001 — artifact, not verdict
                 _log(f"[perf-gate] snapshot save failed: {e!r}")
+        if (args.diff_reference and args.diff_out
+                and os.path.exists(args.diff_reference)):
+            try:
+                save_diff(args.diff_reference, args.diff_out)
+            except Exception as e:  # noqa: BLE001 — artifact, not verdict
+                _log(f"[perf-gate] telemetry diff failed: {e!r}")
 
     if args.update_baseline:
         doc = {
@@ -663,6 +702,13 @@ def main(argv: Optional[list] = None) -> int:
 
         fsio.atomic_write_json(args.baseline, doc, sort_keys=True)
         _log(f"[perf-gate] baseline reseeded -> {args.baseline}")
+        if args.diff_reference:
+            from pyruhvro_tpu.runtime import telemetry as _telemetry
+
+            fsio.atomic_write_json(args.diff_reference,
+                                   _telemetry.snapshot(), sort_keys=True)
+            _log(f"[perf-gate] diff reference reseeded -> "
+                 f"{args.diff_reference}")
         return 0
 
     rows = compare(fresh, baseline, args.tolerance, scale)

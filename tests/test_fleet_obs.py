@@ -1,0 +1,534 @@
+"""Fleet observability (ISSUE 16): trace propagation, OTLP export,
+multi-replica snapshot aggregation and snapshot-diff attribution.
+
+The spawn-pool end of the trace-propagation contract (worker chunk
+spans joining the caller's trace across a real process boundary, OTLP
+round-trip against a collector) is exercised by the CI wheel-job gates
+``scripts/otlp_smoke.py`` + ``scripts/fleet_smoke.py``; this file
+covers everything reachable in-process.
+"""
+
+import gzip
+import json
+import threading
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from pyruhvro_tpu import api
+from pyruhvro_tpu.runtime import (
+    fleet,
+    metrics,
+    obs_server,
+    otel,
+    telemetry,
+    traceprop,
+)
+from pyruhvro_tpu.utils.datagen import KAFKA_SCHEMA_JSON, kafka_style_datums
+
+TRACE_ID = "4bf92f3577b34da6a3ce929d0e0e4736"  # the W3C spec example
+PARENT_SPAN = "00f067aa0ba902b7"
+TRACEPARENT = f"00-{TRACE_ID}-{PARENT_SPAN}-01"
+
+
+# ---------------------------------------------------------------------------
+# traceprop: parsing + resolution
+# ---------------------------------------------------------------------------
+
+
+class TestTraceparentParsing:
+    def test_parse_valid(self):
+        ctx = traceprop.parse(TRACEPARENT)
+        assert ctx == traceprop.TraceContext(TRACE_ID, PARENT_SPAN, "01")
+
+    def test_roundtrip(self):
+        ctx = traceprop.parse(TRACEPARENT)
+        assert ctx.traceparent() == TRACEPARENT
+        assert traceprop.parse(ctx.traceparent()) == ctx
+
+    def test_case_and_whitespace_normalized(self):
+        assert traceprop.parse(
+            "  " + TRACEPARENT.upper() + " ") is not None
+
+    @pytest.mark.parametrize("bad", [
+        "",
+        "garbage",
+        "00-" + TRACE_ID + "-" + PARENT_SPAN,          # missing flags
+        "00-" + TRACE_ID[:-1] + "-" + PARENT_SPAN + "-01",  # short id
+        "ff-" + TRACE_ID + "-" + PARENT_SPAN + "-01",  # version ff
+        "00-" + "0" * 32 + "-" + PARENT_SPAN + "-01",  # zero trace id
+        "00-" + TRACE_ID + "-" + "0" * 16 + "-01",     # zero span id
+    ])
+    def test_parse_rejects_and_counts(self, bad):
+        before = metrics.snapshot().get("trace.parse_error", 0)
+        assert traceprop.parse(bad) is None
+        assert metrics.snapshot().get("trace.parse_error", 0) == before + 1
+
+    def test_coerce_shapes(self):
+        ctx = traceprop.TraceContext(TRACE_ID, PARENT_SPAN)
+        assert traceprop.coerce(ctx) is ctx
+        assert traceprop.coerce(TRACEPARENT) == traceprop.parse(TRACEPARENT)
+        assert traceprop.coerce((TRACE_ID, PARENT_SPAN)).trace_id == TRACE_ID
+        assert traceprop.coerce(None) is None
+        assert traceprop.coerce("") is None
+        # a malformed header can never fail the data-plane call
+        assert traceprop.coerce("not-a-traceparent") is None
+        assert traceprop.coerce(12345) is None
+
+    def test_new_ids_are_well_formed(self):
+        t, s = traceprop.new_trace_id(), traceprop.new_span_id()
+        assert len(t) == 32 and int(t, 16) >= 0
+        assert len(s) == 16 and int(s, 16) >= 0
+        assert traceprop.new_trace_id() != t  # 128-bit: no collisions
+
+
+class TestResolutionOrder:
+    def test_explicit_beats_tls(self):
+        other = traceprop.TraceContext("ab" * 16, "cd" * 8)
+        with traceprop.activate(other):
+            got = traceprop.resolve(TRACEPARENT)
+        assert got.trace_id == TRACE_ID
+
+    def test_tls_beats_env(self, monkeypatch):
+        monkeypatch.setenv("PYRUHVRO_TPU_TRACEPARENT",
+                           f"00-{'ab' * 16}-{'cd' * 8}-01")
+        with traceprop.activate(
+                traceprop.TraceContext(TRACE_ID, PARENT_SPAN)):
+            assert traceprop.resolve().trace_id == TRACE_ID
+
+    def test_env_ingress(self, monkeypatch):
+        monkeypatch.setenv("PYRUHVRO_TPU_TRACEPARENT", TRACEPARENT)
+        got = traceprop.resolve()
+        assert got.trace_id == TRACE_ID
+        assert metrics.snapshot().get("trace.env_ingress", 0) >= 1
+
+    def test_nothing_resolves_to_none(self, monkeypatch):
+        monkeypatch.delenv("PYRUHVRO_TPU_TRACEPARENT", raising=False)
+        assert traceprop.resolve() is None
+
+    def test_activate_restores_previous(self):
+        a = traceprop.TraceContext("ab" * 16, "cd" * 8)
+        with traceprop.activate(a):
+            with traceprop.activate(None):  # explicit detach
+                assert traceprop.current() is None
+            assert traceprop.current() is a
+        assert traceprop.current() is None
+
+
+# ---------------------------------------------------------------------------
+# root spans join the resolved trace
+# ---------------------------------------------------------------------------
+
+
+class TestRootSpanTraceIdentity:
+    def test_explicit_ctx_joins_trace(self):
+        with telemetry.root_span("api.test", trace_ctx=TRACEPARENT):
+            pass
+        sp = telemetry.snapshot()["spans"][-1]
+        assert sp["trace_id"] == TRACE_ID
+        assert sp["parent_span_id"] == PARENT_SPAN
+        assert len(sp["span_id"]) == 16
+
+    def test_fresh_trace_minted_without_ctx(self):
+        with telemetry.root_span("api.test"):
+            pass
+        sp = telemetry.snapshot()["spans"][-1]
+        assert len(sp["trace_id"]) == 32
+        assert "parent_span_id" not in sp  # this process IS the ingress
+
+    def test_nested_roots_inherit_via_tls(self):
+        with telemetry.root_span("api.outer", trace_ctx=TRACEPARENT) as s:
+            with telemetry.root_span("api.inner"):
+                pass
+            outer_span_id = s.span_id
+        outer = telemetry.snapshot()["spans"][-1]
+        inner = outer["children"][-1]
+        assert inner["trace_id"] == TRACE_ID
+        assert inner["parent_span_id"] == outer_span_id
+
+    def test_histogram_exemplar_carries_trace_id(self):
+        with telemetry.root_span("api.test", trace_ctx=TRACEPARENT):
+            pass
+        hist = telemetry.hist_summaries()["api.test_s"]
+        assert hist["exemplar"]["trace_id"] == TRACE_ID
+
+
+class TestApiTracePropagation:
+    def test_deserialize_array_trace_ctx(self):
+        datums = kafka_style_datums(8, seed=1)
+        api.deserialize_array(datums, KAFKA_SCHEMA_JSON, backend="host",
+                              trace_ctx=TRACEPARENT)
+        sp = telemetry.snapshot()["spans"][-1]
+        assert sp["name"] == "api.deserialize_array"
+        assert sp["trace_id"] == TRACE_ID
+        assert sp["parent_span_id"] == PARENT_SPAN
+
+    def test_threaded_pool_shares_one_trace(self):
+        datums = kafka_style_datums(64, seed=2)
+        api.deserialize_array_threaded(
+            datums, KAFKA_SCHEMA_JSON, 4, backend="host",
+            trace_ctx=TRACEPARENT)
+        sp = telemetry.snapshot()["spans"][-1]
+        assert sp["trace_id"] == TRACE_ID
+
+    def test_quarantined_record_carries_trace_id(self):
+        datums = kafka_style_datums(8, seed=3)
+        bad = [d[:2] for d in datums[:2]] + list(datums[2:])
+        _, errs = api.deserialize_array(
+            bad, KAFKA_SCHEMA_JSON, backend="host", on_error="skip",
+            return_errors=True, trace_ctx=TRACEPARENT)
+        assert errs and all(q.trace_id == TRACE_ID for q in errs)
+
+    def test_proc_task_payload_ships_context(self):
+        # the 5-tuple the process pool pickles, executed thread-side:
+        # the worker's span tree must join the shipped trace
+        datums = kafka_style_datums(8, seed=4)
+        _, payload = api._proc_decode_task(
+            (KAFKA_SCHEMA_JSON, list(datums), 0, "raise", TRACEPARENT))
+        assert payload["span"]["trace_id"] == TRACE_ID
+        assert payload["span"]["parent_span_id"] == PARENT_SPAN
+
+    def test_proc_task_quarantine_rebased_with_trace(self):
+        datums = list(kafka_style_datums(8, seed=5))
+        datums[1] = datums[1][:2]
+        _, payload = api._proc_decode_task(
+            (KAFKA_SCHEMA_JSON, datums, 100, "skip", TRACEPARENT))
+        (index, _datum, _err, _tier, trace_id), = payload["quarantine"]
+        assert index == 101  # re-based to the call's global row index
+        assert trace_id == TRACE_ID
+
+    def test_flight_record_trace_and_mono_clock(self):
+        datums = kafka_style_datums(8, seed=6)
+        api.deserialize_array(datums, KAFKA_SCHEMA_JSON, backend="host",
+                              trace_ctx=TRACEPARENT)
+        rec = telemetry.flight_dump()["records"][-1]
+        assert rec["trace_id"] == TRACE_ID
+        # paired clocks: epoch for humans, monotonic for cross-replica
+        # alignment under wall-clock skew
+        assert rec["ts"] > 1e9
+        assert 0 < rec["mono"] < 1e9
+
+
+# ---------------------------------------------------------------------------
+# OTLP mapping + exporter
+# ---------------------------------------------------------------------------
+
+
+def _root_dict():
+    with telemetry.root_span("api.test", trace_ctx=TRACEPARENT,
+                             rows=4):
+        with telemetry.phase("decode.pack_s"):
+            pass
+    return telemetry.snapshot()["spans"][-1]
+
+
+class TestOtlpMapping:
+    def test_spans_to_otlp(self):
+        doc = otel.spans_to_otlp([_root_dict()])
+        spans = doc["resourceSpans"][0]["scopeSpans"][0]["spans"]
+        assert len(spans) == 2
+        root, child = spans
+        assert root["traceId"] == child["traceId"] == TRACE_ID
+        assert root["parentSpanId"] == PARENT_SPAN
+        assert child["parentSpanId"] == root["spanId"]
+        assert root["kind"] == 1
+        assert int(root["endTimeUnixNano"]) >= int(
+            root["startTimeUnixNano"]) > 0
+        attrs = {a["key"]: a["value"] for a in root["attributes"]}
+        assert attrs["rows"] == {"intValue": "4"}
+
+    def test_error_span_maps_status(self):
+        root = _root_dict()
+        root["attrs"]["error"] = "MalformedAvro"
+        doc = otel.spans_to_otlp([root])
+        assert doc["resourceSpans"][0]["scopeSpans"][0]["spans"][0][
+            "status"] == {"code": 2}
+
+    def test_metrics_to_otlp(self):
+        _root_dict()
+        doc = otel.metrics_to_otlp(
+            metrics.snapshot(), {"g.live": 3.0},
+            telemetry.hist_summaries())
+        mets = doc["resourceMetrics"][0]["scopeMetrics"][0]["metrics"]
+        by_name = {m["name"]: m for m in mets}
+        sums = [m for m in mets if "sum" in m]
+        assert sums and all(
+            m["sum"]["isMonotonic"]
+            and m["sum"]["aggregationTemporality"] == 2 for m in sums)
+        assert by_name["g.live"]["gauge"]["dataPoints"][0][
+            "asDouble"] == 3.0
+        h = by_name["api.test_s"]["histogram"]
+        dp = h["dataPoints"][0]
+        # de-cumulated buckets: counts align with bounds (+Inf extra)
+        assert len(dp["bucketCounts"]) == len(dp["explicitBounds"]) + 1
+        assert sum(int(c) for c in dp["bucketCounts"]) == int(dp["count"])
+        assert dp["exemplars"][0]["traceId"] == TRACE_ID
+
+
+class TestOtlpExporter:
+    def test_round_trip_to_stub_collector(self):
+        reqs = []
+
+        class Stub(BaseHTTPRequestHandler):
+            def do_POST(self):
+                n = int(self.headers.get("Content-Length", 0))
+                reqs.append((self.path,
+                             json.loads(self.rfile.read(n))))
+                self.send_response(200)
+                self.end_headers()
+                self.wfile.write(b"{}")
+
+            def log_message(self, *a):
+                pass
+
+        srv = ThreadingHTTPServer(("127.0.0.1", 0), Stub)
+        threading.Thread(target=srv.serve_forever, daemon=True).start()
+        try:
+            ex = otel.start(
+                f"http://127.0.0.1:{srv.server_address[1]}",
+                interval_s=3600)  # flush manually, not on the timer
+            assert otel.exporter() is ex
+            _root_dict()
+            assert ex.flush() is True
+            paths = [p for p, _ in reqs]
+            assert any(p.endswith("/v1/traces") for p in paths)
+            assert any(p.endswith("/v1/metrics") for p in paths)
+            spans = [s for p, b in reqs if p.endswith("/v1/traces")
+                     for rs in b["resourceSpans"]
+                     for ss in rs["scopeSpans"] for s in ss["spans"]]
+            assert {s["traceId"] for s in spans} == {TRACE_ID}
+            snap = metrics.snapshot()
+            assert snap.get("otlp.spans_exported", 0) >= 1
+            assert snap.get("otlp.exports", 0) >= 1
+        finally:
+            otel.stop()
+            srv.shutdown()
+
+    def test_unreachable_collector_counts_and_requeues(self):
+        ex = otel.OtlpExporter("http://127.0.0.1:1", interval_s=3600)
+        _root_dict()
+
+        class _S:
+            def to_dict(self):
+                return _root_dict()
+
+        ex.enqueue(_S())
+        assert ex.flush() is False
+        snap = metrics.snapshot()
+        assert snap.get("otlp.export_errors", 0) >= 1
+        assert len(ex._q) == 1  # the span survives for the retry pass
+
+    def test_stop_detaches_sink(self):
+        otel.stop()
+        assert otel.exporter() is None
+
+
+# ---------------------------------------------------------------------------
+# fleet merge
+# ---------------------------------------------------------------------------
+
+
+def _mini_snap(counters, hist_count=0, gauges=None, slo=None, pid=1):
+    snap = {
+        "schema_version": 3,
+        "pid": pid,
+        "counters": dict(counters),
+        "histograms": {},
+        "spans": [],
+        "spans_dropped": 0,
+        "flight_records": 0,
+    }
+    if hist_count:
+        snap["histograms"]["decode.pack_s"] = {
+            "count": hist_count, "sum": 0.01 * hist_count,
+            "p50": 0.001, "p95": 0.001, "p99": 0.001,
+            "buckets": [[0.001, hist_count], ["+Inf", hist_count]],
+        }
+    if gauges:
+        snap["gauges"] = dict(gauges)
+    if slo:
+        snap["slo"] = slo
+    return snap
+
+
+class TestFleetMerge:
+    def test_counters_sum_exactly(self):
+        a = _mini_snap({"decode.rows": 100.0, "only_a": 1.0})
+        b = _mini_snap({"decode.rows": 50.0, "only_b": 2.0})
+        m = fleet.merge_snapshots([a, b])
+        assert m["counters"] == {
+            "decode.rows": 150.0, "only_a": 1.0, "only_b": 2.0}
+        assert m["fleet"]["count"] == 2
+        assert [r["tag"] for r in m["fleet"]["replicas"]] == ["r0", "r1"]
+
+    def test_histogram_buckets_and_quantiles_merge(self):
+        a = _mini_snap({}, hist_count=10)
+        b = _mini_snap({}, hist_count=30)
+        h = fleet.merge_snapshots([a, b])["histograms"]["decode.pack_s"]
+        assert h["count"] == 40
+        assert h["buckets"][-1] == ["+Inf", 40]
+        assert h["p99"] == 0.001  # everything in the first bucket
+
+    def test_gauges_fold_by_declared_kind(self):
+        a = _mini_snap({}, gauges={"mem.peak_rss": 10.0, "cache.n": 1.0})
+        b = _mini_snap({}, gauges={"mem.peak_rss": 7.0, "cache.n": 2.0})
+        g = fleet.merge_snapshots([a, b])["gauges"]
+        assert g["mem.peak_rss"] == 10.0  # watermark: max, never sum
+        assert g["cache.n"] == 3.0
+
+    def test_slo_breaches_survive_replica_tagged(self):
+        a = _mini_snap({}, slo={
+            "file": "/etc/slo.json",
+            "objectives": [{"name": "decode-p99"}],
+            "breached": ["decode-p99"]})
+        b = _mini_snap({})
+        slo = fleet.merge_snapshots([a, b], tags=["east", "west"])["slo"]
+        assert slo["breached"] == ["[east] decode-p99"]
+        assert slo["objectives"][0]["name"] == "[east] decode-p99"
+        assert slo["objectives"][0]["replica"] == "east"
+
+    def test_merged_doc_renders_everywhere(self):
+        m = fleet.merge_snapshots([_mini_snap({"decode.rows": 1.0},
+                                              hist_count=5)] * 2)
+        assert "phase breakdown" in telemetry.render_report(m)
+        assert "pyruhvro_tpu_decode_rows_total" in telemetry.prometheus(m)
+
+    def test_empty_input_raises(self):
+        with pytest.raises(ValueError):
+            fleet.merge_snapshots([])
+
+    def test_live_snapshot_merges_with_itself(self):
+        datums = kafka_style_datums(16, seed=7)
+        api.deserialize_array(datums, KAFKA_SCHEMA_JSON, backend="host")
+        snap = telemetry.snapshot()
+        m = fleet.merge_snapshots([snap, snap])
+        for k, v in snap["counters"].items():
+            assert m["counters"][k] == v + v
+
+
+# ---------------------------------------------------------------------------
+# diff (regression attribution)
+# ---------------------------------------------------------------------------
+
+
+class TestSnapshotDiff:
+    def test_counter_and_key_classes(self):
+        a = _mini_snap({"decode.rows": 100.0, "gone": 5.0})
+        b = _mini_snap({"decode.rows": 160.0, "born": 1.0})
+        d = fleet.diff_snapshots(a, b)
+        assert d["counters"]["changed"] == [
+            ["decode.rows", 100.0, 160.0, 60.0]]
+        assert d["counters"]["new"] == {"born": 1.0}
+        assert d["counters"]["dead"] == {"gone": 5.0}
+
+    def test_phase_shift_and_routing_mix(self):
+        a = _mini_snap({"route.host": 90.0, "route.device": 10.0},
+                       hist_count=10)
+        b = _mini_snap({"route.host": 50.0, "route.device": 50.0},
+                       hist_count=10)
+        b["histograms"]["decode.pack_s"]["p99"] = 0.064
+        d = fleet.diff_snapshots(a, b)
+        assert d["histograms"]["decode.pack_s"]["p99"] == [0.001, 0.064]
+        assert d["routing_mix"]["host"] == [0.9, 0.5]
+        text = fleet.render_diff(a, b)
+        assert "phase latency shift" in text
+        assert "routing arm mix" in text
+        assert "decode.pack_s" in text
+
+    def test_identical_snapshots_diff_clean(self):
+        a = _mini_snap({"decode.rows": 1.0})
+        assert "no differences" in fleet.render_diff(a, a)
+
+
+# ---------------------------------------------------------------------------
+# CLI: fleet + diff subcommands
+# ---------------------------------------------------------------------------
+
+
+class TestFleetCli:
+    def test_fleet_over_files(self, tmp_path, capsys):
+        pa = tmp_path / "a.json"
+        pb = tmp_path / "b.json"
+        pa.write_text(json.dumps(_mini_snap({"decode.rows": 1.0})))
+        pb.write_text(json.dumps(_mini_snap({"decode.rows": 2.0})))
+        out = tmp_path / "fleet.json"
+        rc = telemetry.main(["fleet", str(pa), str(pb), "-o", str(out)])
+        assert rc == 0
+        merged = json.loads(out.read_text())
+        assert merged["counters"]["decode.rows"] == 3.0
+        assert merged["fleet"]["count"] == 2
+        capsys.readouterr()
+
+    def test_fleet_exit2_contract(self, capsys):
+        assert telemetry.main(["fleet"]) == 2
+        assert telemetry.main(
+            ["fleet", "--scrape", "127.0.0.1:1"]) == 2
+        assert telemetry.main(["fleet", "/nonexistent.json"]) == 2
+        capsys.readouterr()
+
+    def test_diff_cli(self, tmp_path, capsys):
+        pa = tmp_path / "a.json"
+        pb = tmp_path / "b.json"
+        pa.write_text(json.dumps(_mini_snap({"decode.rows": 1.0})))
+        pb.write_text(json.dumps(_mini_snap({"decode.rows": 9.0})))
+        assert telemetry.main(["diff", str(pa), str(pb)]) == 0
+        text = capsys.readouterr().out
+        assert "snapshot diff" in text and "decode.rows" in text
+        assert telemetry.main(
+            ["diff", "--json", str(pa), str(pb)]) == 0
+        doc = json.loads(capsys.readouterr().out)
+        assert doc["counters"]["changed"][0][0] == "decode.rows"
+
+    def test_diff_exit2_contract(self, tmp_path, capsys):
+        good = tmp_path / "good.json"
+        good.write_text(json.dumps(_mini_snap({})))
+        bad = tmp_path / "bad.json"
+        bad.write_text("{not json")
+        assert telemetry.main(
+            ["diff", str(good), "/nonexistent.json"]) == 2
+        assert telemetry.main(["diff", str(good), str(bad)]) == 2
+        capsys.readouterr()
+
+
+# ---------------------------------------------------------------------------
+# obs server: compressed snapshot + exemplar opt-in
+# ---------------------------------------------------------------------------
+
+
+def _get(url):
+    with urllib.request.urlopen(url, timeout=10) as r:
+        return r.read()
+
+
+class TestObsServerFleetSurface:
+    def test_snapshot_compress_roundtrip(self):
+        _root_dict()
+        doc = telemetry.snapshot()
+        srv = obs_server.ObsServer(port=0, snapshot=doc).start()
+        try:
+            plain = _get(srv.url + "/snapshot")
+            gz = _get(srv.url + "/snapshot?compress=1")
+            assert gz[:2] == b"\x1f\x8b" and len(gz) < len(plain)
+            assert json.loads(gzip.decompress(gz)) == json.loads(plain)
+            # the fleet scraper consumes exactly this surface
+            fetched = fleet.fetch_snapshot(f"{srv.host}:{srv.port}")
+            assert fetched["counters"] == json.loads(plain)["counters"]
+        finally:
+            srv.stop()
+
+    def test_metrics_exemplars_opt_in(self):
+        _root_dict()
+        doc = telemetry.snapshot()
+        srv = obs_server.ObsServer(port=0, snapshot=doc).start()
+        try:
+            plain = _get(srv.url + "/metrics").decode()
+            with_ex = _get(srv.url + "/metrics?exemplars=1").decode()
+            # default stays byte-identical to the library exposition —
+            # plain Prometheus scrapers never see exemplar syntax
+            assert plain == telemetry.prometheus(doc)
+            assert "trace_id=" not in plain
+            assert f'# {{trace_id="{TRACE_ID}"}}' in with_ex
+            assert with_ex == telemetry.prometheus(doc, exemplars=True)
+        finally:
+            srv.stop()
