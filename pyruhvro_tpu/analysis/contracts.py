@@ -411,7 +411,8 @@ def check_contracts(root: str, generative: bool = True) -> List[Finding]:
                 f"defines {n_ops} opcodes (pseudo-slots must start right "
                 "after the real ones)"))
         if slots.get("P_MERGE") != slots.get("P_COLLECT", -2) + 1 \
-                or slots.get("N_SLOT") != slots.get("P_MERGE", -2) + 1:
+                or slots.get("P_SHARD") != slots.get("P_MERGE", -2) + 1 \
+                or slots.get("N_SLOT") != slots.get("P_SHARD", -2) + 1:
             findings.append(Finding(
                 "contract.prof-slots", vm_core_rel,
                 f"pseudo-slot layout drifted: {slots}"))
@@ -429,11 +430,11 @@ def check_contracts(root: str, generative: bool = True) -> List[Finding]:
                     "contract.prof-slots", vm_core_rel,
                     f"kSlotName[{i}] is {nm!r}, expected {expect!r} "
                     f"(from {by_value.get(i)})"))
-        if slot_names[len(py_ops):] != ["collect", "merge"]:
+        if slot_names[len(py_ops):] != ["collect", "merge", "shard"]:
             findings.append(Finding(
                 "contract.prof-slots", vm_core_rel,
                 f"pseudo-slot names drifted: {slot_names[len(py_ops):]}"
-                " != ['collect', 'merge']"))
+                " != ['collect', 'merge', 'shard']"))
 
     # -- 5. drain-key prefixes: C++ kDomPrefix <-> the telemetry names
     #       hostpath/codec.py documents/consumes, and every full key must
@@ -568,6 +569,8 @@ def _check_specializer_tables() -> List[Finding]:
 
     prog = lower_host(parse_schema(_ALL_OPS_SCHEMA))
     kinds = {int(k) for k in prog.ops[:, 0]}
+    # every LOWERING-emitted kind (OP_FIXED_RUN=16 is optimizer-only:
+    # the specializer consumes raw programs and never sees it)
     expected_kinds = set(range(16))
     if kinds != expected_kinds:
         return [Finding(

@@ -69,6 +69,7 @@ __all__ = [
     "verify_progress",
     "verify_overflow",
     "verify_equivalence",
+    "verify_optimized",
     "abstract_trace",
     "lattice_points",
     "run_lattice",
@@ -230,6 +231,12 @@ GUARD_ANCHORS: Dict[str, List[Tuple[str, str]]] = {
     "duration_ms_i64": [
         ("pyruhvro_tpu/runtime/native/arrow_decode_core.h",
          r"total > \(uint64_t\)INT64_MAX"),
+    ],
+    # optimizer-fused member run (OP_FIXED_RUN, a=1): ONE upfront span
+    # check justifies every unchecked member read on the bulk lane
+    "fixed_run_span": [
+        ("pyruhvro_tpu/runtime/native/host_codec.cpp",
+         r"op\.b <= \(int64_t\)\(r\.end - r\.cur\)"),
     ],
     # encode wire position checked against int32 offsets per record
     "encode_pos_i32": [
@@ -440,14 +447,17 @@ def verify_structure(m: ProgramModel,
     # returns (end_pc, counts) where counts maps col -> appends per
     # element of THIS region axis (identical for the present and
     # absent modes by the engines' default-append construction).
-    def walk(pc: int, depth: int, axis: int = 0):
+    # ``uncond`` tracks whether every ancestor is a plain record (or a
+    # fused header inside one) — the reachability fact the optimizer's
+    # FLAG_ALWAYS_PRESENT claim must be re-derived against.
+    def walk(pc: int, depth: int, axis: int = 0, uncond: bool = True):
         nonlocal max_seen_depth
         max_seen_depth = max(max_seen_depth, depth)
         if pc >= n:
             f("irverify.progress",
               f"walk ran past the program end at pc {pc}", pc)
             return n, {}
-        kind, a, b, col, nops, _pad = m.ops[pc]
+        kind, a, b, col, nops, pad = m.ops[pc]
         if kind not in hp.OP_EFFECTS:
             f("irverify.effect", f"op {pc}: unknown kind {kind}", pc)
             return pc + 1, {}
@@ -515,6 +525,19 @@ def verify_structure(m: ProgramModel,
         if kind in (hp.OP_FIXED, hp.OP_DEC_FIXED) and a < 0:
             f("irverify.effect", f"op {pc} ({name}): size a={a} < 0", pc)
 
+        # pad-flag discipline: the optimizer's proof-carrying bits are
+        # only meaningful on the ops whose engines read them; a stray
+        # bit elsewhere is a corrupted (or misapplied) rewrite
+        allowed_pad = 0
+        if kind == hp.OP_FIXED_RUN:
+            allowed_pad = hp.FLAG_ALWAYS_PRESENT
+        elif kind in (hp.OP_ARRAY, hp.OP_MAP):
+            allowed_pad = hp.FLAG_STR_ITEMS
+        if pad & ~allowed_pad:
+            f("irverify.optimize",
+              f"op {pc} ({name}): pad flag bits {pad:#x} are not "
+              "permitted on this op kind", pc)
+
         counts: Dict[int, int] = {}
 
         def push(counts_, c, k=1):
@@ -527,17 +550,70 @@ def verify_structure(m: ProgramModel,
         if kind == hp.OP_RECORD:
             p = pc + 1
             while p < stop:
-                p, cp = walk(p, depth + 1, axis)
+                p, cp = walk(p, depth + 1, axis, uncond)
                 for c, k in cp.items():
                     push(counts, c, k)
             if p != stop:
                 f("irverify.effect",
                   f"op {pc} (record): children end at {p}, nops claims "
                   f"{stop}", pc)
+        elif kind == hp.OP_FIXED_RUN:
+            # optimizer-emitted header (hostpath/optimize.py): >= 2
+            # plain fixed-layout leaves of one record, walked on the
+            # SAME axis. Every operand claim is re-derived, never
+            # trusted: b must equal the members' summed wire floors
+            # (the bulk lane's span pre-check admits exactly b bytes)
+            # and a=1 only when every member is exact-width — one span
+            # check cannot bound a varint member's reads.
+            fusable = {hp.OP_INT: 1, hp.OP_LONG: 1, hp.OP_FLOAT: 4,
+                       hp.OP_DOUBLE: 8, hp.OP_BOOL: 1}
+            exact_kinds = (hp.OP_FLOAT, hp.OP_DOUBLE, hp.OP_BOOL)
+            member_pcs = []
+            p = pc + 1
+            while p < stop:
+                member_pcs.append(p)
+                mk = m.ops[p][0]
+                maux = m.aux[p] if p < len(m.aux) else None
+                if mk not in fusable or maux is not None:
+                    f("irverify.optimize",
+                      f"op {pc} (fixed_run): member at pc {p} "
+                      f"(kind {hp.OP_NAMES.get(mk, mk)}, aux={maux!r}) "
+                      "is not a plain fixed-layout leaf — the bulk "
+                      "lane would misread the wire", pc)
+                p, cp = walk(p, depth + 1, axis, uncond)
+                for c, k in cp.items():
+                    push(counts, c, k)
+            if p != stop:
+                f("irverify.effect",
+                  f"op {pc} (fixed_run): members end at {p}, nops "
+                  f"claims {stop}", pc)
+            if len(member_pcs) < 2:
+                f("irverify.optimize",
+                  f"op {pc} (fixed_run): {len(member_pcs)} member(s) "
+                  "— a fused header must absorb >= 2 leaves", pc)
+            width = sum(fusable.get(m.ops[q][0], 0)
+                        for q in member_pcs)
+            if b != width:
+                f("irverify.optimize",
+                  f"op {pc} (fixed_run): b={b} but the members' wire "
+                  f"floors sum to {width} — the span pre-check would "
+                  "mis-bound the bulk reads", pc)
+            want_exact = int(bool(member_pcs) and all(
+                m.ops[q][0] in exact_kinds for q in member_pcs))
+            if a != want_exact:
+                f("irverify.optimize",
+                  f"op {pc} (fixed_run): a={a} but exact-width is "
+                  f"{want_exact} — a=1 over varint members licenses "
+                  "unchecked reads one span check cannot bound", pc)
+            if (pad & hp.FLAG_ALWAYS_PRESENT) and not uncond:
+                f("irverify.optimize",
+                  f"op {pc} (fixed_run): FLAG_ALWAYS_PRESENT under a "
+                  "conditional ancestor chain — the bulk lane would "
+                  "consume wire bytes for an absent subtree", pc)
         elif kind == hp.OP_NULLABLE:
             # both the live and the null side execute the inner subtree
             # (live decodes, null appends defaults) — same counts
-            p, cp = walk(pc + 1, depth + 1, axis)
+            p, cp = walk(pc + 1, depth + 1, axis, False)
             for c, k in cp.items():
                 push(counts, c, k)
             if p != stop:
@@ -552,7 +628,7 @@ def verify_structure(m: ProgramModel,
                       f"op {pc} (union): arm {_k} of {a} missing "
                       f"(subtree exhausted at {p})", pc)
                     break
-                p, cp = walk(p, depth + 1, axis)
+                p, cp = walk(p, depth + 1, axis, False)
                 for c, k in cp.items():
                     push(counts, c, k)
             if p != stop:
@@ -567,7 +643,18 @@ def verify_structure(m: ProgramModel,
             next_rid[0] += 1
             if kind == hp.OP_MAP:
                 region_check(b, pc, "map-key", rid)
-            p, cp = walk(pc + 1, depth + 1, rid)
+            if pad & hp.FLAG_STR_ITEMS:
+                # the optimizer's pre-decided string block lane: the
+                # claim must match the engines' own runtime test
+                # (item subtree == exactly one OP_STRING leaf)
+                item_kind = m.ops[pc + 1][0] if pc + 1 < n else None
+                if nops != 2 or item_kind != hp.OP_STRING:
+                    f("irverify.optimize",
+                      f"op {pc} ({name}): FLAG_STR_ITEMS but the item "
+                      f"subtree is not a single string leaf "
+                      f"(nops={nops}, item kind={item_kind}) — the "
+                      "string block lane would misread the items", pc)
+            p, cp = walk(pc + 1, depth + 1, rid, False)
             if kind == hp.OP_MAP:
                 push(cp, b)  # the key column, once per item
             check_axis(cp, pc, f"op {pc} ({name}) item axis")
@@ -629,7 +716,9 @@ def _min_wire(m: ProgramModel, pc: int) -> Tuple[int, int]:
     hp = _effects()
     kind, a, b, col, nops, _pad = m.ops[pc]
     stop = pc + max(nops, 1)
-    if kind == hp.OP_RECORD:
+    if kind in (hp.OP_RECORD, hp.OP_FIXED_RUN):
+        # a fused header consumes nothing itself; its members still
+        # account their own floors (op.b only SUMMARIZES them)
         total = 0
         p = pc + 1
         while p < stop:
@@ -698,7 +787,8 @@ def verify_progress(m: ProgramModel,
                     "engines", pc))
             walk(pc + 1)
             return stop
-        if kind in (hp.OP_RECORD, hp.OP_NULLABLE, hp.OP_UNION):
+        if kind in (hp.OP_RECORD, hp.OP_NULLABLE, hp.OP_UNION,
+                    hp.OP_FIXED_RUN):
             p = pc + 1
             while p < stop:
                 p = walk(p)
@@ -924,6 +1014,59 @@ def verify_program(prog, guards: Dict[str, bool],
     return findings
 
 
+def verify_optimized(orig, opt, guards: Dict[str, bool],
+                     consumers: Dict[str, List[str]],
+                     label: str = "optimized") -> List[Finding]:
+    """The superoptimizer's equivalence oracle
+    (``hostpath/optimize.py``). The optimized program must (1) pass
+    every abstract-interpretation pass on its own — including the
+    ``irverify.optimize`` re-derivation of each fused header's operand
+    claims and flag bits — and (2) strip back to the ORIGINAL program
+    byte-for-byte (headers spliced out, flags cleared, ancestor
+    ``nops`` restored): a rewrite that cannot round-trip is by
+    definition not effect-preserving. Zero findings proves the
+    rewrite; ANY finding makes the caller reject the program (it is
+    counted, never run)."""
+    findings = verify_program(opt, guards, consumers, label=label,
+                              equivalence=False)
+    try:
+        from ..hostpath.optimize import strip_optimizations
+
+        stripped = strip_optimizations(opt)
+    except Exception as e:
+        findings.append(Finding(
+            "irverify.optimize", label,
+            f"optimized program does not strip back to a raw program: "
+            f"{type(e).__name__}: {e}"))
+        return findings
+    got = [tuple(int(x) for x in row) for row in stripped.ops]
+    want = [tuple(int(x) for x in row) for row in orig.ops]
+    if got != want:
+        i = next((k for k, (x, y) in enumerate(zip(got, want))
+                  if x != y), min(len(got), len(want)))
+        findings.append(Finding(
+            "irverify.optimize", label,
+            f"strip(optimized) != original program: {len(got)} vs "
+            f"{len(want)} ops, first divergence at stripped pc {i} — "
+            "the rewrite reordered or altered a member op", i))
+
+    def norm_aux(p, count):
+        ax = tuple(p.op_aux or ())
+        return ax if ax else (None,) * count
+
+    if norm_aux(stripped, len(got)) != norm_aux(orig, len(want)):
+        findings.append(Finding(
+            "irverify.optimize", label,
+            "strip(optimized) aux table != original aux table — the "
+            "rewrite moved or dropped a logical-type fact"))
+    if [int(c) for c in stripped.coltypes] != \
+            [int(c) for c in orig.coltypes]:
+        findings.append(Finding(
+            "irverify.optimize", label,
+            "strip(optimized) coltypes != original coltypes"))
+    return findings
+
+
 # ---------------------------------------------------------------------------
 # the schema-construct lattice driver
 # ---------------------------------------------------------------------------
@@ -958,6 +1101,21 @@ _CONSTRUCTS = [
     ("record", lambda u: '{"type": "record", "name": "Sub%s", '
                          '"fields": [{"name": "x", "type": "int"}]}'
                          % u),
+    # optimizer coverage: records whose adjacent fixed-layout leaves
+    # fuse into OP_FIXED_RUN — exact-width (bulk-lane a=1) and
+    # varint-mixed (dispatch-only a=0) — so the lattice verifies the
+    # fused-op programs the engines actually execute, not just the raw
+    # lowerings
+    ("exact_run_rec", lambda u: '{"type": "record", "name": "Xr%s", '
+                                '"fields": [{"name": "a", "type": '
+                                '"double"}, {"name": "b", "type": '
+                                '"float"}, {"name": "c", "type": '
+                                '"boolean"}]}' % u),
+    ("varint_run_rec", lambda u: '{"type": "record", "name": "Vr%s", '
+                                 '"fields": [{"name": "a", "type": '
+                                 '"long"}, {"name": "b", "type": '
+                                 '"int"}, {"name": "c", "type": '
+                                 '"double"}]}' % u),
 ]
 
 _UNION_LIKE = ("nullable", "union")
@@ -1036,15 +1194,22 @@ def lattice_points(depths: Optional[Sequence[int]] = None) -> List[dict]:
 def run_lattice(guards: Dict[str, bool],
                 consumers: Dict[str, List[str]],
                 depths: Optional[Sequence[int]] = None,
-                equivalence: bool = True):
+                equivalence: bool = True,
+                optimizer: bool = True):
     """Verify every constructible lattice point; returns
-    (findings, report-dict with per-point verdicts + coverage)."""
+    (findings, report-dict with per-point verdicts + coverage). With
+    ``optimizer`` (the default) every point's program is ALSO run
+    through the superoptimizer — whose internal oracle re-verifies the
+    rewritten program against this module's passes — so the lattice
+    covers the fused-op programs the engines actually execute, and a
+    rewrite the oracle rejects on any constructible schema is a gate
+    finding."""
     from ..hostpath.program import lower_host
     from ..schema.parser import parse_schema
 
     findings: List[Finding] = []
     points = lattice_points(depths)
-    constructible = verified = 0
+    constructible = verified = optimized = fused_runs = 0
     for point in points:
         if point.get("status") == "skipped-invalid":
             continue
@@ -1061,6 +1226,35 @@ def run_lattice(guards: Dict[str, bool],
             continue
         fs = verify_program(prog, guards, consumers, label=label,
                             equivalence=equivalence)
+        if optimizer:
+            from ..hostpath.optimize import optimize_program
+
+            try:
+                _opt, ost = optimize_program(prog)
+            except Exception as e:
+                fs.append(Finding(
+                    "irverify.optimize", label,
+                    f"optimizer crashed on a lattice point: "
+                    f"{type(e).__name__}: {e}"))
+            else:
+                if ost.applied or ost.rejected:
+                    point["optimizer"] = {
+                        "applied": ost.applied,
+                        "fused_runs": ost.fused_runs,
+                        "always_present": ost.always_present,
+                        "str_items": ost.str_items,
+                        "rejected": ost.rejected,
+                    }
+                if ost.applied:
+                    optimized += 1
+                    fused_runs += ost.fused_runs
+                if ost.rejected:
+                    fs.append(Finding(
+                        "irverify.optimize", label,
+                        "optimizer rewrite rejected by the "
+                        "equivalence oracle on a constructible "
+                        "lattice point — the rewrite pass is unsound "
+                        f"here: {ost.findings[:2]!r}"))
         if fs:
             point["status"] = "failed"
             point["findings"] = [f.to_dict() for f in fs]
@@ -1077,6 +1271,9 @@ def run_lattice(guards: Dict[str, bool],
         "coverage_pct": round(100.0 * verified / constructible, 2)
         if constructible else 0.0,
     }
+    if optimizer:
+        coverage["optimized"] = optimized
+        coverage["fused_runs"] = fused_runs
     return findings, {"points": points, "coverage": coverage}
 
 
@@ -1101,6 +1298,20 @@ _REF_SCHEMA = """
 _ZW_SCHEMA = """
 {"type": "record", "name": "ZwRef", "fields": [
   {"name": "a", "type": {"type": "array", "items": "null"}}
+]}
+"""
+
+# optimizer-mutation reference: an unconditional exact-width run (x, y,
+# k — fused with a=1 + FLAG_ALWAYS_PRESENT) plus a second run under a
+# nullable chain (p, q — fused but NOT always-present)
+_OPT_SCHEMA = """
+{"type": "record", "name": "OptRef", "fields": [
+  {"name": "x", "type": "double"},
+  {"name": "y", "type": "float"},
+  {"name": "k", "type": "boolean"},
+  {"name": "opt", "type": ["null", {"type": "record", "name": "OInner",
+    "fields": [{"name": "p", "type": "double"},
+               {"name": "q", "type": "double"}]}]}
 ]}
 """
 
@@ -1262,6 +1473,54 @@ def run_mutation_selftest(guards: Dict[str, bool],
                "irverify.equiv"),
               ("equiv", "kops-row-tamper", kops_row_tamper,
                "irverify.equiv")]
+
+    # -- optimize class (superoptimizer rewrites vs the oracle) -----------
+    from ..hostpath import optimize as hopt
+
+    opt_raw = lower_host(parse_schema(_OPT_SCHEMA))
+    opt_prog, _ost = hopt.optimize_program(opt_raw, verify=False)
+
+    def _mutated_opt(mutfn):
+        import numpy as np
+
+        mut = copy.deepcopy(opt_prog)
+        ops = np.array(mut.ops, copy=True)
+        mutfn(ops)
+        mut.ops = ops
+        return verify_optimized(opt_raw, mut, guards, consumers)
+
+    def _run_pcs(ops):
+        return [i for i in range(len(ops))
+                if int(ops[i][0]) == hp.OP_FIXED_RUN]
+
+    def fused_span_tamper():
+        # a rewrite that mis-sums the members' wire floors: the bulk
+        # lane's span pre-check would admit reads past the record
+        def mt(ops):
+            ops[_run_pcs(ops)[0]][2] += 1
+        return _mutated_opt(mt)
+
+    def reordered_rewrite():
+        # members swapped inside the fused run: structure still tiles
+        # and the span sum is unchanged — only strip-equality sees it
+        def mt(ops):
+            pc = _run_pcs(ops)[0]
+            ops[[pc + 1, pc + 2]] = ops[[pc + 2, pc + 1]]
+        return _mutated_opt(mt)
+
+    def always_present_overclaim():
+        # the nullable-chain run flagged always-present: the bulk lane
+        # would consume wire bytes when the record is absent
+        def mt(ops):
+            ops[_run_pcs(ops)[-1]][5] |= hp.FLAG_ALWAYS_PRESENT
+        return _mutated_opt(mt)
+
+    cases += [("optimize", "fused-span-tamper", fused_span_tamper,
+               "irverify.optimize"),
+              ("optimize", "reordered-rewrite", reordered_rewrite,
+               "irverify.optimize"),
+              ("optimize", "always-present-overclaim",
+               always_present_overclaim, "irverify.optimize")]
 
     findings: List[Finding] = []
     rows = []

@@ -416,6 +416,7 @@ _METRIC_PRODUCERS = {
     ("telemetry", "phase"): "span",
     ("telemetry", "root_span"): "span",
     ("telemetry", "observe"): "span",
+    ("telemetry", "observe_value"): "histogram",
     # memory-plane probe names become the mem.<plane>.* gauge namespace
     ("memacct", "register_probe"): "plane",
 }

@@ -952,6 +952,10 @@ def _deserialize_chunks(dec, entry, data, schema, num_chunks, bounds,
     """The chunked decode body, on the decided (tier, pool) arm."""
     tier, impl = dec.tier, dec.impl
     use_proc = dec.pool == "process"  # router/env picked the spawn pool
+    # the decided pool rides into the native codec as a placement hint
+    # ("shard" = one-call C++ shard runner, "thread" = the serial
+    # per-chunk loop); the other tiers' impls take no such hint
+    native_kw = {"pool": dec.pool} if tier == "native" else {}
     # the caller's live trace context (the root span is already open),
     # shipped verbatim so worker chunk spans join the caller's trace
     tp = traceprop.current_traceparent()
@@ -969,7 +973,7 @@ def _deserialize_chunks(dec, entry, data, schema, num_chunks, bounds,
             dec.degraded = True  # thread path serves a process-arm call
         if tier != "fallback":
             try:
-                out = impl.decode_threaded(data, num_chunks)
+                out = impl.decode_threaded(data, num_chunks, **native_kw)
                 return (out, []) if return_errors else out
             except Exception as e:
                 if tier != "native" or not _native_degradable(e):
@@ -1019,7 +1023,8 @@ def _deserialize_chunks(dec, entry, data, schema, num_chunks, bounds,
             # them, so the screening per-chunk path serves instead.
             if tier != "fallback" and not max_datum_bytes():
                 try:
-                    out = impl.decode_threaded(data, num_chunks)
+                    out = impl.decode_threaded(data, num_chunks,
+                                               **native_kw)
                 except DeadlineExceeded:
                     raise  # a call contract, not a reason to re-decode
                 except Exception:
@@ -1119,6 +1124,8 @@ def _serialize_chunks(dec, entry, batch, schema, num_chunks, bounds,
     """The chunked encode body, on the decided (tier, pool) arm."""
     tier, impl = dec.tier, dec.impl
     use_proc = dec.pool == "process"  # router/env picked the spawn pool
+    # placement hint for the native codec (see _deserialize_chunks)
+    native_kw = {"pool": dec.pool} if tier == "native" else {}
     tp = traceprop.current_traceparent()  # ships the caller's trace
     if on_error == "raise":
         if use_proc:
@@ -1133,7 +1140,7 @@ def _serialize_chunks(dec, entry, batch, schema, num_chunks, bounds,
             dec.degraded = True  # thread path serves a process-arm call
         if tier != "fallback":
             try:
-                out = impl.encode_threaded(batch, num_chunks)
+                out = impl.encode_threaded(batch, num_chunks, **native_kw)
                 return (out, []) if return_errors else out
             except Exception as e:
                 # BatchTooLarge (a capacity contract) is not a

@@ -94,6 +94,21 @@ class NativeHostCodec:
             raise RuntimeError("native host codec unavailable (no toolchain)")
         from ..runtime import knobs
 
+        # the opcode superoptimizer (hostpath/optimize.py): fused runs /
+        # elision flags, accepted ONLY when the irverify oracle proves
+        # effect equality. The optimized program serves the GENERIC VM
+        # call sites; the RAW program stays the source of truth for the
+        # specializer, the encode plan and the assembler. A stale .so
+        # (no ``shard_stats`` export ⇒ predates OP_FIXED_RUN) pins the
+        # raw program — an old switch would silently skip fused members.
+        self.oprog: HostProgram = self.prog
+        self.opt_stats = None
+        if (not knobs.get_bool("PYRUHVRO_TPU_NO_OPT")
+                and hasattr(self._mod, "shard_stats")):
+            from .optimize import optimize_program
+
+            self.oprog, self.opt_stats = optimize_program(self.prog)
+
         self._spec = None            # the specialized module, once built
         self._spec_name = None       # its engine-registry key (ISSUE 12)
         # the per-opcode profiler lives in the generic VM's dispatch
@@ -213,11 +228,17 @@ class NativeHostCodec:
                                  specialized=(spec_eng is not None
                                               and deep_mod is None),
                                  fused=fused is not None):
+                # generic engines run the OPTIMIZED program when the
+                # loaded binary understands it (same stale-.so probe as
+                # __init__ — the deep-sampled prof module is a separate
+                # binary with its own staleness)
+                gprog = (self.oprog if hasattr(eng, "shard_stats")
+                         else self.prog)
                 if fused is not None:
                     if generic:
                         payload, err_rec, err_bits = fused(
-                            self.prog.ops, self.prog.coltypes,
-                            self.prog.op_aux, native_data,
+                            gprog.ops, gprog.coltypes,
+                            gprog.op_aux, native_data,
                             _vm_threads(nthreads),
                         )
                     else:
@@ -226,7 +247,7 @@ class NativeHostCodec:
                         )
                 elif generic:
                     payload, err_rec, err_bits = eng.decode(
-                        self.prog.ops, self.prog.coltypes, native_data,
+                        gprog.ops, gprog.coltypes, native_data,
                         _vm_threads(nthreads)
                     )
                 else:
@@ -294,19 +315,109 @@ class NativeHostCodec:
     # batches keep the single pass + zero-copy slices.
     _PER_CHUNK_ROWS = 1 << 16
 
-    def decode_threaded(self, data: Sequence[bytes],
-                        num_chunks: int) -> List[pa.RecordBatch]:
-        """Chunked decode → one RecordBatch per chunk (reference chunk
-        slicing, ``deserialize.rs:57-68``); the VM threads shard rows
-        internally within each decode.
+    def _drain_shard_stats(self) -> dict:
+        """Snapshot-and-clear the native shard-runner counters from
+        every loaded engine module (each extension .so has its own pool
+        and stats singleton). Missing exports (stale binaries) read as
+        zeros."""
+        tot = {"fanouts": 0, "shards": 0, "shard_s": 0.0, "wall_s": 0.0,
+               "threads": 0}
+        for m in (self._mod, self._spec, self._extract_mod):
+            drain = getattr(m, "shard_stats", None) if m else None
+            if drain is None:
+                continue
+            d = drain()
+            tot["fanouts"] += d["fanouts"]
+            tot["shards"] += d["shards"]
+            tot["shard_s"] += d["shard_s"]
+            tot["wall_s"] += d["wall_s"]
+            tot["threads"] = max(tot["threads"], d["threads"])
+        return tot
 
-        Both execution shapes now say what the chunk count bought
-        (the BENCH_r05 flat-sweep blind spot): the large-batch
-        per-chunk mode runs under a ``pool.fanout_s`` span whose
-        ``chunk_efficiency`` exposes that the chunks run serially (the
-        VM's internal row sharding is the parallelism), and the
-        small-batch path annotates ``chunk_mode=slice`` — one decode,
-        zero fan-out, so x1 vs x16 SHOULD be flat there."""
+    def _native_shards_usable(self) -> bool:
+        """May the one-call native shard-runner path serve a chunked
+        decode? Requires a binary that has the pool (``shard_stats``
+        export) and an un-opened ``native_shards`` breaker; the knob
+        pins the historic serial per-chunk loop."""
+        from ..runtime import knobs
+
+        if knobs.get_bool("PYRUHVRO_TPU_NO_NATIVE_SHARDS"):
+            return False
+        return hasattr(self._mod, "shard_stats")
+
+    def _decode_native_shards(self, data: Sequence[bytes],
+                              bounds) -> "List[pa.RecordBatch] | None":
+        """One native call for the whole batch: the C++ shard runner is
+        the fan-out (workers parked between calls), Python only slices
+        the finished RecordBatch per chunk. Returns None to degrade to
+        the retained serial per-chunk loop (breaker open, injected
+        shard_worker fault, or a runtime lane fault)."""
+        from ..ops.arrow_build import compact_union_slices
+        from ..runtime import breaker, deadline, faults, metrics, telemetry
+        from ..runtime.pool import fanout_stats
+
+        br = breaker.get("native_shards")
+        if not br.acquire():
+            metrics.inc("shard.breaker_open")
+            return None
+        # per-chunk seam checkpoints BEFORE the (uninterruptible) native
+        # call: an expired deadline still stops at a chunk boundary
+        # naming the first row it never decoded, and the chaos harness's
+        # shard_worker faults fire at the same per-chunk granularity the
+        # serial loop had
+        try:
+            for a, _b in bounds:
+                deadline.check(index=a, site="host.chunk")
+                faults.fire("shard_worker")
+        except faults.FaultInjected:
+            br.record_failure()
+            metrics.inc("shard.fallback")
+            metrics.inc("shard.fallback_fault")
+            return None
+        except BaseException:
+            br.release()  # deadline expiry: contract, not a lane verdict
+            raise
+        telemetry.annotate(chunk_mode="native_shard")
+        self._drain_shard_stats()  # discard counters from other callers
+        try:
+            with fanout_stats(len(bounds), native=True) as stats:
+                batch = self.decode(data)
+                d = self._drain_shard_stats()
+                if d["fanouts"]:
+                    stats.native_fanout(d["shard_s"], d["wall_s"],
+                                        d["threads"])
+        except Exception as e:
+            if faults.degradable(e):
+                # lane fault (VM module bug, injected vm_decode error):
+                # the serial per-chunk loop still serves the call
+                br.record_failure()
+                metrics.inc("shard.fallback")
+                return None
+            br.record_success()  # data/contract condition, lane worked
+            raise
+        br.record_success()
+        metrics.inc("shard.native")
+        return [
+            compact_union_slices(batch.slice(a, b - a)) for a, b in bounds
+        ]
+
+    def decode_threaded(self, data: Sequence[bytes], num_chunks: int,
+                        pool: "str | None" = None
+                        ) -> List[pa.RecordBatch]:
+        """Chunked decode → one RecordBatch per chunk (reference chunk
+        slicing, ``deserialize.rs:57-68``).
+
+        ``pool`` is the router's placement hint: ``"shard"`` (or None
+        with a shard-capable binary) sends the large-batch mode through
+        ONE native call — the C++ shard runner fans rows out over its
+        persistent worker pool and Python slices the result — while
+        ``"thread"`` keeps the historic serial per-chunk loop (also the
+        degradation target when the ``native_shards`` breaker is open).
+        Every shape reports what the fan-out bought: the native path
+        feeds ``pool.chunk_efficiency`` from the runner's own busy/wall
+        counters, the serial loop from per-chunk timings, and the
+        small-batch path annotates ``chunk_mode=slice`` (one decode,
+        zero fan-out, flat by design)."""
         import time as _time
 
         from ..ops.arrow_build import compact_union_slices
@@ -318,6 +429,10 @@ class NativeHostCodec:
         if len(data) >= self._PER_CHUNK_ROWS * max(len(bounds), 1):
             from ..runtime import deadline
 
+            if pool != "thread" and self._native_shards_usable():
+                out = self._decode_native_shards(data, bounds)
+                if out is not None:
+                    return out
             with fanout_stats(len(bounds), serial=True) as stats:
                 out = []
                 for a, b in bounds:
@@ -375,7 +490,7 @@ class NativeHostCodec:
         )
 
     def _encode_native(self, batch: pa.RecordBatch, n: int,
-                       checked: int) -> pa.Array:
+                       checked: int, nshards: int = 1) -> pa.Array:
         """The fused Arrow-native encode: export the column-matched
         struct through the Arrow C data interface and run extraction +
         wire encode in ONE GIL-released C++ call — no Python/numpy
@@ -425,7 +540,7 @@ class NativeHostCodec:
             return None
         try:
             return self._encode_native_admitted(
-                batch, n, checked, br, spec, mod)
+                batch, n, checked, br, spec, mod, nshards)
         except (BatchTooLarge, OverflowError):
             # contract/data conditions raised THROUGH the lane: the
             # native call itself executed correctly, so a half-open
@@ -439,7 +554,8 @@ class NativeHostCodec:
             raise
 
     def _encode_native_admitted(self, batch: pa.RecordBatch, n: int,
-                                checked: int, br, spec, mod):
+                                checked: int, br, spec, mod,
+                                nshards: int = 1):
         """The admitted half of :meth:`_encode_native` — every return
         path below delivers its own breaker verdict; raising paths are
         resolved by the caller's except clauses."""
@@ -457,16 +573,16 @@ class NativeHostCodec:
         )
         try:
             if spec is not None:
-                res = spec.encode_arrow(
-                    self.prog.coltypes, int(holder_a.ctypes.data),
-                    int(holder_s.ctypes.data), n, checked,
-                )
+                args = (self.prog.coltypes, int(holder_a.ctypes.data),
+                        int(holder_s.ctypes.data), n, checked)
+                res = spec.encode_arrow(*(args + (nshards,) if nshards > 1
+                                          else args))
             else:
-                res = mod.encode(
-                    self.prog.ops, self.prog.coltypes, self.prog.op_aux,
-                    int(holder_a.ctypes.data), int(holder_s.ctypes.data),
-                    n, checked,
-                )
+                args = (self.prog.ops, self.prog.coltypes,
+                        self.prog.op_aux, int(holder_a.ctypes.data),
+                        int(holder_s.ctypes.data), n, checked)
+                res = mod.encode(*(args + (nshards,) if nshards > 1
+                                   else args))
         except OverflowError as e:
             if "decimal" in str(e):
                 raise  # oracle parity — a batch split cannot help
@@ -625,17 +741,85 @@ class NativeHostCodec:
             _drain_native_prof(self._mod)
         return self._wrap_blob(blob, offs, n)
 
-    def encode_threaded(self, batch: pa.RecordBatch,
-                        num_chunks: int) -> List[pa.Array]:
+    def _encode_native_shards(self, batch: pa.RecordBatch,
+                              bounds) -> "List[pa.Array] | None":
+        """One native call for the whole chunked encode: the fused
+        extract+encode boundary shards rows over the persistent C++
+        pool (extract_core.h encode_arrow_sharded) and Python slices
+        the finished BinaryArray per chunk. None degrades to the
+        retained per-chunk process-pool fan-out."""
+        from ..ops.decode import BatchTooLarge
+        from ..runtime import breaker, faults, knobs, metrics, telemetry
+        from ..runtime.pool import fanout_stats
+
+        br = breaker.get("native_shards")
+        if not br.acquire():
+            metrics.inc("shard.breaker_open")
+            return None
+        try:
+            for _a, _b in bounds:
+                faults.fire("shard_worker")
+        except faults.FaultInjected:
+            br.record_failure()
+            metrics.inc("shard.fallback")
+            metrics.inc("shard.fallback_fault")
+            return None
+        except BaseException:
+            br.release()
+            raise
+        telemetry.annotate(chunk_mode="native_shard")
+        n = batch.num_rows
+        checked = 1 if knobs.get_bool("PYRUHVRO_DEBUG_BOUNDS") else 0
+        self._drain_shard_stats()  # discard counters from other callers
+        try:
+            with fanout_stats(len(bounds), native=True,
+                              op="encode") as stats:
+                arr = self._encode_native(batch, n, checked,
+                                          nshards=len(bounds))
+                d = self._drain_shard_stats()
+                if d["fanouts"]:
+                    stats.native_fanout(d["shard_s"], d["wall_s"],
+                                        d["threads"])
+        except BatchTooLarge:
+            # capacity contract (int32 wire total): the retained path's
+            # recursive splitter serves the call — the lane itself worked
+            br.record_success()
+            metrics.inc("shard.fallback")
+            return None
+        except Exception as e:
+            if faults.degradable(e):
+                br.record_failure()
+                metrics.inc("shard.fallback")
+                return None
+            br.record_success()
+            raise
+        if arr is None:
+            # the Arrow-native extract lane declined (shape/data) — not
+            # a shard-runner fault; the retained path words the error
+            br.record_success()
+            metrics.inc("shard.fallback")
+            return None
+        br.record_success()
+        metrics.inc("shard.native")
+        return [arr.slice(a, b - a) for a, b in bounds]
+
+    def encode_threaded(self, batch: pa.RecordBatch, num_chunks: int,
+                        pool: "str | None" = None) -> List[pa.Array]:
         """Encode ONCE, slice per chunk (one VM pass regardless of the
         chunk count — the chunked return shape is an API contract, not a
         unit of work). An oversized batch is split recursively, still
-        through the VM."""
+        through the VM. Large batches prefer ONE native shard-runner
+        call (``pool="shard"`` hint or default); ``pool="thread"`` or a
+        degradation keeps the per-chunk process-pool fan-out."""
         from ..ops.decode import BatchTooLarge
         from ..runtime.chunking import chunk_bounds
 
         bounds = chunk_bounds(batch.num_rows, num_chunks)
         if batch.num_rows >= self._PER_CHUNK_ROWS * max(len(bounds), 1):
+            if pool != "thread" and self._native_shards_usable():
+                out = self._encode_native_shards(batch, bounds)
+                if out is not None:
+                    return out
             # large chunks: one encode per chunk (cache-resident working
             # set, ≙ the reference's per-chunk serialize fan-out), run
             # on the process pool — the fused Arrow-native encode

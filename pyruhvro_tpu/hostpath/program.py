@@ -41,6 +41,29 @@ __all__ = ["HostProgram", "lower_host", "COL_NBUF", "OP_NAMES",
 OP_RECORD, OP_INT, OP_LONG, OP_FLOAT, OP_DOUBLE, OP_BOOL = 0, 1, 2, 3, 4, 5
 OP_STRING, OP_ENUM, OP_NULL, OP_NULLABLE, OP_UNION = 6, 7, 8, 9, 10
 OP_ARRAY, OP_MAP, OP_FIXED, OP_DEC_BYTES, OP_DEC_FIXED = 11, 12, 13, 14, 15
+# superoptimizer-only op (hostpath/optimize.py): a fused header over a
+# run of ≥2 consecutive fixed-layout leaf fields of one record. Never
+# emitted by lower_host — only the verified rewrite pass inserts it.
+#   a    — 1 when every member is exact-width (float/double/bool): the
+#          engines may take the bulk lane (one span pre-check, then
+#          unchecked member reads); 0 = dispatch-only grouping
+#   b    — total minimum wire bytes of the member run (the bulk lane's
+#          span pre-check amount — for all-fixed runs it is exact)
+#   nops — 1 + member count (members stay in-stream, unchanged)
+OP_FIXED_RUN = 16
+
+# ``pad`` flag bits (optimizer-set; 0 on every lower_host program).
+# FLAG_ALWAYS_PRESENT on an OP_FIXED_RUN header asserts the header's
+# ancestor chain is unconditional (records only): the engines may skip
+# the runtime ``present`` test on the bulk lane. FLAG_STR_ITEMS on an
+# OP_ARRAY/OP_MAP asserts the item subtree is exactly one plain
+# string/bytes leaf, pre-deciding decode_blocks' string fast lane at
+# compile time. Both are PROOF-CARRYING: analysis/irverify.py
+# verify_optimized re-derives each claim and rejects the program when
+# the flag overclaims (a wrong flag would mean wire reads for absent
+# subtrees / string reads over non-string items).
+FLAG_ALWAYS_PRESENT = 1
+FLAG_STR_ITEMS = 2
 
 # column types (≙ host_codec.cpp ColType)
 COL_I32, COL_I64, COL_F32, COL_F64, COL_U8, COL_STR, COL_OFFS = range(7)
@@ -54,7 +77,7 @@ OP_NAMES = {
     OP_STRING: "string", OP_ENUM: "enum", OP_NULL: "null",
     OP_NULLABLE: "nullable", OP_UNION: "union", OP_ARRAY: "array",
     OP_MAP: "map", OP_FIXED: "fixed", OP_DEC_BYTES: "dec_bytes",
-    OP_DEC_FIXED: "dec_fixed",
+    OP_DEC_FIXED: "dec_fixed", OP_FIXED_RUN: "fixed_run",
 }
 
 # Per-opcode effect contract, the machine-readable half of what the two
@@ -122,6 +145,14 @@ OP_EFFECTS = {
                        aux=("!decimal",)),
     OP_DEC_FIXED: dict(ctype=COL_U8, min_wire="a", pushes=("u8",),
                        sinks=(), aux=("!decimal",)),
+    # fused header: consumes no wire bytes itself (its b operand only
+    # SUMMARIZES the members' floors for the bulk lane's span
+    # pre-check — the members still account their own min_wire), pushes
+    # nothing, owns no column. The bulk lane reads members unchecked,
+    # which is sound only behind the span pre-check the sink names.
+    OP_FIXED_RUN: dict(ctype=None, min_wire=0, pushes=(),
+                       sinks=(("bulk_span", ("fixed_run_span",)),),
+                       aux=(None,)),
 }
 
 # numpy dtypes per buffer, in buffer order

@@ -663,13 +663,17 @@ PyObject* py_encode_arrow_spec(PyObject*, PyObject* args) {{
   PyObject* coltypes_obj;
   unsigned long long addr_a, addr_s;
   Py_ssize_t n;
-  int checked = 0;
-  if (!PyArg_ParseTuple(args, "OKKn|i", &coltypes_obj, &addr_a, &addr_s,
-                        &n, &checked))
+  int checked = 0, nshards = 1;
+  if (!PyArg_ParseTuple(args, "OKKn|ii", &coltypes_obj, &addr_a, &addr_s,
+                        &n, &checked, &nshards))
     return nullptr;
   return encode_arrow_boundary(EncRec{{}}, kOps, kAux, coltypes_obj,
                                (uintptr_t)addr_a, (uintptr_t)addr_s, n,
-                               checked);
+                               checked, nshards);
+}}
+
+PyObject* py_shard_stats_spec(PyObject*, PyObject*) {{
+  return shard_stats_py();
 }}
 
 PyMethodDef methods[] = {{
@@ -681,8 +685,10 @@ PyMethodDef methods[] = {{
     {{"encode", py_encode_spec, METH_VARARGS,
      "encode(coltypes, buffers, n, size_hint=0) -> (blob, offsets)"}},
     {{"encode_arrow", py_encode_arrow_spec, METH_VARARGS,
-     "encode_arrow(coltypes, addr_array, addr_schema, n, checked=0)"
-     " -> (blob, offsets, t_extract_s, t_encode_s) | status int"}},
+     "encode_arrow(coltypes, addr_array, addr_schema, n, checked=0, "
+     "nshards=1) -> (blob, offsets, t_extract_s, t_encode_s) | status int"}},
+    {{"shard_stats", py_shard_stats_spec, METH_NOARGS,
+     "shard_stats() -> {{fanouts, shards, shard_s, wall_s, threads}}"}},
     {{nullptr, nullptr, 0, nullptr}},
 }};
 
@@ -916,7 +922,7 @@ def load_specialized(prog: HostProgram):
     try:
         core_text = ""
         for name in ("host_vm_core.h", "extract_core.h",
-                     "arrow_decode_core.h"):
+                     "arrow_decode_core.h", "shard_runner.h"):
             with open(os.path.join(_native_dir(), name)) as f:
                 core_text += f.read() + "\x00"
         probe = generate_source(prog, "M")  # name-independent content

@@ -261,6 +261,10 @@ _DEFAULTS = {
     "process_pool": (1, 1.0),
     "device_backend": (1, 1.0),
     "native_extract": (2, 1.0),
+    # the one-call native shard-runner fan-out (hostpath/codec.py
+    # decode_threaded): its fallback — the serial per-chunk loop — is
+    # warm and correct, so a couple of cheap failures may probe first
+    "native_shards": (2, 1.0),
     # the OTLP exporter's collector seam: tolerate one failed flush
     # (collectors restart), then back off — a dead collector costs one
     # probe per backoff window instead of one timeout per interval

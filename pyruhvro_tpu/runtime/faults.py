@@ -75,6 +75,8 @@ SITES = (
     "native_build",     # runtime/native/build.py: extension compile/load
     "native_extract",   # hostpath/codec.py: fused Arrow-native encode lane
     "vm_decode",        # hostpath/codec.py: the C++ VM decode call
+    "shard_worker",     # hostpath/codec.py: per-shard seam of the
+                        # native shard-runner decode/encode fan-out
     "device_compile",   # device_obs.InstrumentedJit: lower().compile()
     "device_launch",    # device_obs.InstrumentedJit: executable launch
     "h2d",              # ops/decode.py: host->device transfer

@@ -122,6 +122,15 @@ _reg("PYRUHVRO_TPU_NO_NATIVE_EXTRACT", "bool", False,
 _reg("PYRUHVRO_TPU_NO_FUSED_DECODE", "bool", False,
      "Pin decode's Arrow assembly to the Python oracle instead of the "
      "fused native decode_arrow pass.")
+_reg("PYRUHVRO_TPU_SHARD_THREADS", "int", 0,
+     "Cap the native shard-runner pool's worker count (0 = auto: "
+     "hardware concurrency, max 16).")
+_reg("PYRUHVRO_TPU_NO_NATIVE_SHARDS", "bool", False,
+     "Pin chunked decode/encode to the historic serial per-chunk "
+     "Python loop instead of the one-call native shard runner.")
+_reg("PYRUHVRO_TPU_NO_OPT", "bool", False,
+     "Disable the opcode superoptimizer (hostpath/optimize.py): run "
+     "the raw lowered program with no fused runs or elision flags.")
 _reg("PYRUHVRO_DEBUG_BOUNDS", "bool", False,
      "Native encoder verifies every write against the extractor's "
      "bound instead of trusting it.")

@@ -249,6 +249,13 @@ class ArrowFinalize {
         while (p < stop && st_ == 0) p = node(p, count, mask, out);
         return p;
       }
+      case OP_FIXED_RUN: {
+        // optimizer header: no Arrow node of its own — members
+        // finalize exactly as in the raw program (same count/mask)
+        size_t p = pc + 1, stop = pc + (size_t)op.nops;
+        while (p < stop && st_ == 0) p = node(p, count, mask, out);
+        return p;
+      }
       case OP_INT:
         return prim_node(pc, count, mask, out, COL_I32, 4);
       case OP_LONG:

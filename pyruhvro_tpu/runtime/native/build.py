@@ -108,7 +108,7 @@ def _needs_build(so: str, src: str) -> bool:
     src_mtime = os.path.getmtime(src)
     # editing a shared core header must rebuild its includers too
     for name in ("host_vm_core.h", "extract_core.h",
-                 "arrow_decode_core.h"):
+                 "arrow_decode_core.h", "shard_runner.h"):
         hdr = os.path.join(_HERE, name)
         if os.path.exists(hdr):
             src_mtime = max(src_mtime, os.path.getmtime(hdr))

@@ -45,9 +45,9 @@ PyObject* py_encode_arrow(PyObject*, PyObject* args) {
   PyObject *ops_obj, *coltypes_obj, *aux_obj;
   unsigned long long addr_a, addr_s;
   Py_ssize_t n;
-  int checked = 0;
-  if (!PyArg_ParseTuple(args, "OOOKKn|i", &ops_obj, &coltypes_obj, &aux_obj,
-                        &addr_a, &addr_s, &n, &checked))
+  int checked = 0, nshards = 1;
+  if (!PyArg_ParseTuple(args, "OOOKKn|ii", &ops_obj, &coltypes_obj, &aux_obj,
+                        &addr_a, &addr_s, &n, &checked, &nshards))
     return nullptr;
   BufferGuard ops_b;
   const Op* ops;
@@ -58,8 +58,12 @@ PyObject* py_encode_arrow(PyObject*, PyObject* args) {
   VmEncRec rec{ops};
   return encode_arrow_boundary(rec, ops, at.aux.data(), coltypes_obj,
                                (uintptr_t)addr_a, (uintptr_t)addr_s, n,
-                               checked);
+                               checked, nshards);
 }
+
+// shard_stats() -> cumulative shard-runner fan-out counters (clears);
+// this module's own pool (each extension compiles its own copy)
+PyObject* py_shard_stats(PyObject*, PyObject*) { return shard_stats_py(); }
 
 PyObject* py_extract_arrow(PyObject*, PyObject* args) {
   PyObject *ops_obj, *coltypes_obj, *aux_obj;
@@ -91,11 +95,15 @@ PyMethodDef methods[] = {
      "prof_drain() -> {telemetry_key: (hits, ns)} (clears the counters)"},
 #endif
     {"encode", py_encode_arrow, METH_VARARGS,
-     "encode(ops, coltypes, aux, addr_array, addr_schema, n, checked=0)"
-     " -> (blob, offsets[n+1], t_extract_s, t_encode_s) | status int"},
+     "encode(ops, coltypes, aux, addr_array, addr_schema, n, checked=0, "
+     "nshards=1) -> (blob, offsets[n+1], t_extract_s, t_encode_s) | "
+     "status int"},
     {"extract", py_extract_arrow, METH_VARARGS,
      "extract(ops, coltypes, aux, addr_array, addr_schema, n)"
      " -> (buffers, bound) | status int"},
+    {"shard_stats", py_shard_stats, METH_NOARGS,
+     "shard_stats() -> {fanouts, shards, shard_s, wall_s, threads} "
+     "(clears the counters)"},
     {nullptr, nullptr, 0, nullptr},
 };
 

@@ -740,20 +740,167 @@ inline void fill_incols(const std::vector<OutBuf>& outs,
   }
 }
 
+// ---- sharded fused encode: the shard-runner fan-out ------------------
+//
+// Each shard runs its OWN extractor over a row window of the same
+// adopted Arrow batch (a windowed root AView — exactly how a nonzero
+// ArrowArray.offset is already handled) and encodes into a private
+// VecWriter; the merge under the GIL is a blob concat + offsets rebase.
+// Serial semantics are preserved exactly: the FIRST failing shard in
+// row order reports (what a one-pass encode would have raised first),
+// and checked mode verifies each shard's writer against its own
+// extractor bound. Returned timings are per-shard busy SUMS (the
+// callers' host.extract_native_s / host.encode_vm_s split measures
+// work, not wall).
+template <class Rec>
+inline PyObject* encode_arrow_sharded(Rec rec, const Op* ops,
+                                      const OpAux* aux,
+                                      const int32_t* coltypes, size_t ncols,
+                                      ArrowOwner& owner, Py_ssize_t n,
+                                      int checked, int nt) {
+  struct EncShard {
+    int64_t a = 0, b = 0;
+    int status = EXTRACT_OK;
+    bool overflow = false, vm_err = false, oom = false;
+    size_t over_by = 0;
+    int64_t bound = 0;
+    std::vector<uint8_t> out;
+    std::vector<int32_t> sizes;  // shard-local offsets, leading 0
+    double t_extract = 0.0, t_encode = 0.0, busy = 0.0;
+  };
+  std::vector<EncShard> shards((size_t)nt);
+  int64_t per = n / nt;
+  for (int t = 0; t < nt; t++) {
+    shards[(size_t)t].a = per * t;
+    shards[(size_t)t].b = t == nt - 1 ? (int64_t)n : per * (t + 1);
+  }
+  double wall0 = 0.0, wall1 = 0.0;
+  Py_BEGIN_ALLOW_THREADS;
+  wall0 = shard::now_s();
+  shard::Pool::instance().run(nt, [&](int t) {
+    EncShard& sh = shards[(size_t)t];  // distinct index per shard
+    double s0 = shard::now_s();
+    try {
+      ArrowExtractor ex(ops, aux, coltypes, ncols);
+      AView root{&owner.arr, &owner.sch, owner.arr.offset + sh.a,
+                 sh.b - sh.a};
+      double e0 = shard::now_s();
+      ex.walk(0, root, nullptr);
+      sh.t_extract = shard::now_s() - e0;
+      sh.status = ex.status;
+      sh.bound = ex.bound;
+      if (sh.status == EXTRACT_OK) {
+        std::vector<InCol> cols;
+        fill_incols(ex.outs, coltypes, ncols, cols);
+        Py_ssize_t ns = (Py_ssize_t)(sh.b - sh.a);
+        sh.sizes.resize((size_t)ns + 1);
+        try {  // best-effort presize; VecWriter grows if it misses
+          sh.out.reserve((size_t)(sh.bound < 16 ? 16 : sh.bound));
+        } catch (const std::bad_alloc&) {
+        }
+        VecWriter w{&sh.out};
+        double c0 = shard::now_s();
+        run_encode_t(rec, cols, w, ns, sh.sizes.data(), &sh.overflow,
+                     &sh.vm_err);
+        sh.t_encode = shard::now_s() - c0;
+        if (checked && (int64_t)sh.out.size() > sh.bound)
+          sh.over_by = sh.out.size() - (size_t)sh.bound;
+      }
+    } catch (const std::bad_alloc&) {
+      sh.oom = true;
+    }
+    PYR_PROF_FLUSH();
+    sh.busy = shard::now_s() - s0;
+  });
+  wall1 = shard::now_s();
+  Py_END_ALLOW_THREADS;
+
+  std::vector<double> busy((size_t)nt);
+  double t_extract = 0.0, t_encode = 0.0;
+  for (int t = 0; t < nt; t++) {
+    busy[(size_t)t] = shards[(size_t)t].busy;
+    t_extract += shards[(size_t)t].t_extract;
+    t_encode += shards[(size_t)t].t_encode;
+  }
+  shard::Stats::instance().record(nt, wall1 - wall0, busy.data(), nt);
+
+  for (auto& sh : shards) {  // first failure in row order = serial report
+    if (sh.oom) {
+      PyErr_NoMemory();
+      return nullptr;
+    }
+    if (sh.status != EXTRACT_OK) return PyLong_FromLong(sh.status);
+    if (sh.over_by != 0) {
+      PyErr_Format(PyExc_RuntimeError,
+                   "encode bound violated: writer overran the extractor's "
+                   "%lld-byte bound by %zu bytes (PYRUHVRO_DEBUG_BOUNDS)",
+                   (long long)sh.bound, sh.over_by);
+      return nullptr;
+    }
+    if (sh.overflow || sh.vm_err) {
+      PyErr_SetString(PyExc_OverflowError,
+                      sh.overflow
+                          ? "encoded batch exceeds int32 binary offsets"
+                          : "decimal value does not fit its fixed size");
+      return nullptr;
+    }
+  }
+
+  int64_t total = 0;
+  for (auto& sh : shards) total += (int64_t)sh.out.size();
+  if (total > (int64_t)INT32_MAX) {
+    PyErr_SetString(PyExc_OverflowError,
+                    "encoded batch exceeds int32 binary offsets");
+    return nullptr;
+  }
+  PyObject* blob = PyBytes_FromStringAndSize(nullptr, (Py_ssize_t)total);
+  if (!blob) return nullptr;
+  uint8_t* dst = reinterpret_cast<uint8_t*>(PyBytes_AS_STRING(blob));
+  std::vector<int32_t> sizes;
+  try {
+    sizes.resize((size_t)n + 1);
+  } catch (const std::bad_alloc&) {
+    Py_DECREF(blob);
+    PyErr_NoMemory();
+    return nullptr;
+  }
+  sizes[0] = 0;
+  int64_t base = 0;
+  for (auto& sh : shards) {
+    if (!sh.out.empty())
+      std::memcpy(dst + base, sh.out.data(), sh.out.size());
+    int64_t ns = sh.b - sh.a;
+    for (int64_t i = 1; i <= ns; i++)
+      sizes[(size_t)(sh.a + i)] = (int32_t)(base + sh.sizes[(size_t)i]);
+    base += (int64_t)sh.out.size();
+  }
+  PyObject* szb = bytes_from(sizes.data(), sizes.size() * 4);
+  if (!szb) {
+    Py_DECREF(blob);
+    return nullptr;
+  }
+  PyObject* res = Py_BuildValue("(OOdd)", blob, szb, t_extract, t_encode);
+  Py_DECREF(blob);
+  Py_DECREF(szb);
+  return res;
+}
+
 // ---- fused boundary: extract + encode in one GIL-released call -------
 //
 // encode_arrow(…) -> (blob, offsets[n+1], t_extract_s, t_encode_s)
 //                  | int status (EXTRACT_FALLBACK / EXTRACT_DATA_ERROR)
 // The caller (hostpath/codec.py) maps an int result back onto the
 // Python extractor path; timings feed the host.extract_native_s /
-// host.encode_vm_s telemetry split.
+// host.encode_vm_s telemetry split. ``nshards > 1`` requests the
+// sharded fan-out above (subject to pick_threads' rows-per-shard floor
+// and the PYRUHVRO_TPU_SHARD_THREADS cap).
 template <class Rec>
 inline PyObject* encode_arrow_boundary(Rec rec, const Op* ops,
                                        const OpAux* aux,
                                        PyObject* coltypes_obj,
                                        uintptr_t addr_arr,
                                        uintptr_t addr_sch, Py_ssize_t n,
-                                       int checked) {
+                                       int checked, int nshards = 1) {
   BufferGuard ct_b;
   if (!ct_b.acquire(coltypes_obj, "coltypes")) return nullptr;
   const int32_t* coltypes = static_cast<const int32_t*>(ct_b.view.buf);
@@ -764,6 +911,15 @@ inline PyObject* encode_arrow_boundary(Rec rec, const Op* ops,
   if (owner.arr.length != n) {
     PyErr_SetString(PyExc_ValueError, "arrow length != row count");
     return nullptr;
+  }
+
+  if (nshards > 1) {
+    int nt = pick_threads(n, nshards);
+    int cap = shard::env_threads_cap();  // PYRUHVRO_TPU_SHARD_THREADS
+    if (cap > 0 && nt > cap) nt = cap;
+    if (nt > 1)
+      return encode_arrow_sharded(rec, ops, aux, coltypes, ncols, owner, n,
+                                  checked, nt);
   }
 
   ArrowExtractor ex(ops, aux, coltypes, ncols);

@@ -159,6 +159,51 @@ class Vm {
         offs.i32.push_back(offs.running);
         return pc + 1 + ops_[pc + 1].nops;
       }
+      case OP_FIXED_RUN: {
+        // optimizer-fused run of fixed-layout record leaves
+        // (hostpath/optimize.py). Bulk lane: op.a == 1 means every
+        // member is exact-width (proved by the irverify oracle), so ONE
+        // span pre-check over the run's total width justifies the
+        // unchecked member reads below. Runs with varint members
+        // (op.a == 0) and short-input tails fall through to per-member
+        // dispatch — byte-identical to the raw program.
+        bool live = present || (op.pad & FLAG_ALWAYS_PRESENT) != 0;
+        size_t p = pc + 1, stop = pc + op.nops;
+        if (op.a == 1 && live && op.b <= (int64_t)(r.end - r.cur)) {
+          const uint8_t* src = r.base + r.cur;
+          while (p < stop) {
+            const Op& m = ops_[p];
+            Col& c = (*cols_)[m.col];
+            switch (m.kind) {
+              case OP_FLOAT: {
+                float v;
+                std::memcpy(&v, src, 4);
+                c.f32.push_back(v);
+                src += 4;
+                break;
+              }
+              case OP_DOUBLE: {
+                double v;
+                std::memcpy(&v, src, 8);
+                c.f64.push_back(v);
+                src += 8;
+                break;
+              }
+              default: {  // OP_BOOL — the only other exact-width member
+                uint8_t v = *src++;
+                if (v > 1) r.err |= ERR_BAD_BOOL;
+                c.u8.push_back(v);
+                break;
+              }
+            }
+            p++;
+          }
+          r.cur += (size_t)op.b;
+          return stop;
+        }
+        while (p < stop) p = exec(p, r, present);
+        return p;
+      }
     }
     return pc + 1;  // unreachable for well-formed programs
   }
@@ -172,7 +217,10 @@ class Vm {
     // string fast lane: array-of-string items (and map values) skip the
     // exec dispatch entirely — the item loop is read-len / bulk-copy
     // against hoisted column refs (the kafka emails/phone_numbers shape)
-    bool str_items = ops_[pc + 1].kind == OP_STRING && op.nops == 2;
+    // FLAG_STR_ITEMS: the optimizer pre-decided the shape (oracle-
+    // verified); the dynamic test stays for raw programs
+    bool str_items = (op.pad & FLAG_STR_ITEMS) != 0 ||
+                     (ops_[pc + 1].kind == OP_STRING && op.nops == 2);
     Col* item_col = str_items ? &(*cols_)[ops_[pc + 1].col] : nullptr;
     Col* key_col = is_map ? &(*cols_)[op.b] : nullptr;
     for (;;) {
@@ -562,6 +610,9 @@ PyObject* py_uuid_text(PyObject*, PyObject* args) {
 PyObject* py_prof_drain(PyObject*, PyObject*) { return prof::drain_py(); }
 #endif
 
+// shard_stats() -> cumulative shard-runner fan-out counters (clears)
+PyObject* py_shard_stats(PyObject*, PyObject*) { return shard_stats_py(); }
+
 PyMethodDef methods[] = {
     {"decode", py_decode, METH_VARARGS,
      "decode(ops, coltypes, flat, offsets, n, nthreads=0) -> "
@@ -584,6 +635,9 @@ PyMethodDef methods[] = {
      "uuid_text(raw16, count) -> 36*count chars of canonical uuid text"},
     {"dec128_check", py_dec128_check, METH_VARARGS,
      "dec128_check(raw16, count, bound_hi, bound_lo) -> first bad row or -1"},
+    {"shard_stats", py_shard_stats, METH_NOARGS,
+     "shard_stats() -> {fanouts, shards, shard_s, wall_s, threads} "
+     "(clears the counters)"},
     {nullptr, nullptr, 0, nullptr},
 };
 

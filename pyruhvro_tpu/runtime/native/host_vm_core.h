@@ -28,6 +28,8 @@
 #include <utility>
 #include <vector>
 
+#include "shard_runner.h"
+
 #ifdef PYRUHVRO_NATIVE_PROF
 #include <atomic>
 #include <chrono>
@@ -58,13 +60,15 @@ namespace pyr {
 namespace prof {
 
 enum Domain : int { DOM_VM = 0, DOM_ENC = 1, DOM_EXT = 2, N_DOM = 3 };
-// slots 0..15 mirror OpKind; 16/17 are the boundary pseudo-ops
-enum : int { P_COLLECT = 16, P_MERGE = 17, N_SLOT = 18 };
+// slots 0..16 mirror OpKind; 17..19 are the boundary pseudo-ops
+// (span collection, shard-buffer merge, shard fan-out orchestration)
+enum : int { P_COLLECT = 17, P_MERGE = 18, P_SHARD = 19, N_SLOT = 20 };
 
 inline const char* const kSlotName[N_SLOT] = {
     "record", "int",  "long",     "float", "double",    "bool",
     "string", "enum", "null",     "nullable", "union",  "array",
-    "map",    "fixed", "dec_bytes", "dec_fixed", "collect", "merge",
+    "map",    "fixed", "dec_bytes", "dec_fixed", "fixed_run",
+    "collect", "merge", "shard",
 };
 inline const char* const kDomPrefix[N_DOM] = {"vm.op.", "vm.encop.",
                                               "extract.op."};
@@ -172,6 +176,25 @@ enum OpKind : int32_t {
   OP_FIXED = 13,      // a = byte size; col = raw bytes (size per entry)
   OP_DEC_BYTES = 14,  // decimal over bytes; col = 16B LE words
   OP_DEC_FIXED = 15,  // a = byte size; decimal over fixed; col = 16B LE
+  // optimizer-emitted (hostpath/optimize.py; never lowered directly):
+  // header over a run of >= 2 consecutive fixed-layout leaf members of
+  // one record. a = 1 iff every member is exact-width (bulk-lane
+  // eligible), b = total member min-wire bytes, nops = 1 + members.
+  // Members follow unchanged, so dropping headers recovers the raw
+  // program byte-for-byte — the equivalence oracle's invariant.
+  OP_FIXED_RUN = 16,
+};
+
+// Op::pad flag bits, optimizer-set and proof-carried (the irverify
+// oracle re-derives each claim before an optimized program ever runs;
+// keep in sync with hostpath/program.py)
+enum OpFlag : int32_t {
+  // on OP_FIXED_RUN: every ancestor is a record/fused header, so the
+  // walk can never reach this op with present=false
+  FLAG_ALWAYS_PRESENT = 1,
+  // on OP_ARRAY/OP_MAP: the item subtree is exactly one string leaf —
+  // take the block loop's read-len/bulk-copy lane unconditionally
+  FLAG_STR_ITEMS = 2,
 };
 
 // ---- column types (keep in sync with hostpath/program.py) ------------
@@ -826,6 +849,8 @@ inline void run_all_shards(RecFn rec, const int32_t* coltypes, size_t ncols,
                            std::vector<ShardResult>& shards) {
   Py_ssize_t n = sc.n;
   int nt = pick_threads(n, nthreads);
+  int cap = shard::env_threads_cap();  // PYRUHVRO_TPU_SHARD_THREADS
+  if (cap > 0 && nt > cap) nt = cap;
   // NOTE (measured twice, r05): neither sub-sharding the serial path
   // (~4k-row shards, all live) NOR an incremental merge-and-free
   // sub-batch mode reproduced the ~30% gain separate small decode
@@ -870,19 +895,30 @@ inline void run_all_shards(RecFn rec, const int32_t* coltypes, size_t ncols,
     run_shard_t(rec, coltypes, ncols, spans.data(), 0, n, &shards[0], pp,
                 total_scale);
   } else {
-    std::vector<std::thread> threads;
+    // fan out through the persistent pool (shard_runner.h): the caller
+    // runs shard 0 and then steals, workers claim the rest — no thread
+    // create/join inside the call. ``rec`` is shared by reference
+    // across shards, which its contract allows (stateless per record).
+    PYR_PROF_OP(pyr::prof::DOM_VM, pyr::prof::P_SHARD);
+    double wall0 = shard::now_s();
+    std::vector<double> shard_s((size_t)nt, 0.0);
     int64_t per = n / nt;
-    for (int t = 0; t < nt; t++) {
+    const Span* sp = spans.data();
+    shard::Pool::instance().run(nt, [&](int t) {
+      double t0 = shard::now_s();
       int64_t a = per * t;
       int64_t b = (t == nt - 1) ? n : per * (t + 1);
-      ShardResult* sr = &shards[(size_t)t];
       double sc2 = total_scale * ((double)(b - a) / (double)n);
-      threads.emplace_back([rec, coltypes, ncols, &spans, a, b, sr, pp,
-                            sc2]() {
-        run_shard_t(rec, coltypes, ncols, spans.data(), a, b, sr, pp, sc2);
-      });
-    }
-    for (auto& th : threads) th.join();
+      run_shard_t(rec, coltypes, ncols, sp, a, b, &shards[(size_t)t], pp,
+                  sc2);
+      shard_s[(size_t)t] = shard::now_s() - t0;  // distinct index per shard
+      // reopen attribution on the calling thread so its steal/drain and
+      // the completion wait land in the shard pseudo-slot (workers'
+      // counters flushed inside run_shard_t)
+      if (t == 0) PYR_PROF_OP(pyr::prof::DOM_VM, pyr::prof::P_SHARD);
+    });
+    shard::Stats::instance().record(nt, shard::now_s() - wall0,
+                                    shard_s.data(), nt);
   }
   Py_END_ALLOW_THREADS;
 }
@@ -970,6 +1006,18 @@ inline PyObject* decode_boundary(RecFn rec, PyObject* coltypes_obj,
   return out;
 }
 
+
+// shard_stats() -> dict: snapshot-and-clear of the shard-runner's
+// cumulative fan-out counters (shard_runner.h). Python's fanout_stats
+// derives pool.chunk_efficiency from busy/wall/shards without any
+// per-shard Python call existing. GIL held.
+inline PyObject* shard_stats_py() {
+  shard::StatsSnap s = shard::Stats::instance().drain();
+  return Py_BuildValue(
+      "{s:K,s:K,s:d,s:d,s:i}", "fanouts", (unsigned long long)s.fanouts,
+      "shards", (unsigned long long)s.shards, "shard_s", s.shard_s,
+      "wall_s", s.wall_s, "threads", s.last_threads);
+}
 
 // ===================== encode (Arrow -> Avro wire) ====================
 //
@@ -1219,6 +1267,13 @@ class EncVm {
         }
         if (present) out_->push(0);  // block terminator
         return pc + 1 + ops_[pc + 1].nops;
+      }
+      case OP_FIXED_RUN: {
+        // encode has no span check to hoist — the header is dispatch
+        // grouping only; members emit exactly as in the raw program
+        size_t p = pc + 1, stop = pc + op.nops;
+        while (p < stop) p = exec(p, present);
+        return p;
       }
     }
     return pc + 1;  // unreachable for well-formed programs

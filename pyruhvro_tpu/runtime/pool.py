@@ -45,7 +45,8 @@ from typing import Callable, List, Optional, Sequence
 from . import breaker, deadline, knobs, metrics, telemetry, traceprop
 
 __all__ = ["get_pool", "map_chunks", "get_process_pool", "map_chunks_proc",
-           "pool_mode", "process_available", "fanout_stats"]
+           "pool_mode", "process_available", "shard_available",
+           "fanout_stats"]
 
 _pool = None       # guarded-by: _lock
 _proc_pool = None  # guarded-by: _lock
@@ -66,6 +67,22 @@ def process_available() -> bool:
     return breaker.get("process_pool").allow()
 
 
+def shard_available() -> bool:
+    """Can the one-call native shard-runner arm be offered? Requires a
+    host-codec binary that carries the C++ pool (the ``shard_stats``
+    export — probed WITHOUT triggering a JIT build, so cold-start calls
+    simply don't see the arm until the module is warm), an un-opened
+    ``native_shards`` breaker, and the
+    ``PYRUHVRO_TPU_NO_NATIVE_SHARDS`` knob unset."""
+    if knobs.get_bool("PYRUHVRO_TPU_NO_NATIVE_SHARDS"):
+        return False
+    from .native import build
+
+    if build.loaded_host_codec_with("shard_stats") is None:
+        return False
+    return breaker.get("native_shards").allow()
+
+
 class fanout_stats:
     """Measure one chunk fan-out's parallel efficiency.
 
@@ -82,15 +99,26 @@ class fanout_stats:
     paying — now every fan-out span says exactly how much it paid.
     """
 
-    __slots__ = ("chunks", "attrs", "_dts", "_ph", "_t0")
+    __slots__ = ("chunks", "attrs", "_dts", "_ph", "_t0", "_native")
 
     def __init__(self, chunks: int, **attrs):
         self.chunks = chunks
         self.attrs = attrs
         self._dts: List[float] = []
+        self._native = None
 
     def chunk(self, seconds: float) -> None:
         self._dts.append(seconds)  # list.append is atomic under the GIL
+
+    def native_fanout(self, busy_s: float, wall_s: float,
+                      threads: int) -> None:
+        """Feed a NATIVE fan-out's own measurements (the shard runner's
+        drained counters, hostpath/codec.py): efficiency computes from
+        the in-call busy/wall over the actual worker count instead of
+        Python-side per-chunk timings — the Python wall around a single
+        native call includes span collection and Arrow assembly, which
+        would understate how well the shards overlapped."""
+        self._native = (busy_s, wall_s, threads)
 
     def __enter__(self) -> "fanout_stats":
         self._ph = telemetry.phase("pool.fanout_s", chunks=self.chunks,
@@ -103,7 +131,19 @@ class fanout_stats:
         wall = time.perf_counter() - self._t0
         span = self._ph.span
         self._ph.__exit__(exc_type, exc, tb)
-        if exc_type is None and self._dts and wall > 0 and self.chunks > 0:
+        if exc_type is not None:
+            return False
+        if self._native is not None:
+            busy, nwall, nthreads = self._native
+            if nwall > 0 and nthreads > 0:
+                eff = min(1.0, busy / (nwall * nthreads))
+                metrics.inc("pool.eff_fanouts")
+                telemetry.observe_value("pool.chunk_efficiency", eff)
+                if span is not None:
+                    span.attrs["chunk_efficiency"] = round(eff, 4)
+                    span.attrs["threads"] = nthreads
+                    span.attrs["speedup"] = round(busy / nwall, 3)
+        elif self._dts and wall > 0 and self.chunks > 0:
             eff = min(1.0, sum(self._dts) / (wall * self.chunks))
             metrics.inc("pool.eff_fanouts")
             telemetry.observe_value("pool.chunk_efficiency", eff)

@@ -96,13 +96,20 @@ class RouteDecision:
         self._done = False
 
 
-def _pools_for(tier: str, chunks: int, proc_ok: bool) -> Tuple[str, ...]:
+def _pools_for(tier: str, chunks: int, proc_ok: bool,
+               shard_ok: bool = False) -> Tuple[str, ...]:
     """Pool-kind component of the arm space: host tiers with a real
     fan-out choose thread vs process; the device tier's chunk axis is
-    the mesh, and a single chunk has nothing to fan out."""
+    the mesh, and a single chunk has nothing to fan out. The native
+    tier additionally offers ``shard`` — the ONE-native-call C++
+    shard-runner fan-out — whenever the binary carries the pool and its
+    breaker is not open (``pool.shard_available``)."""
     if tier == "device" or chunks <= 1:
         return ("none",)
-    return ("thread", "process") if proc_ok else ("thread",)
+    pools = ("thread", "process") if proc_ok else ("thread",)
+    if tier == "native" and shard_ok:
+        pools = ("shard",) + pools
+    return pools
 
 
 def _nearest_arm(offered: Dict[str, Any], static_tier: str,
@@ -112,7 +119,8 @@ def _nearest_arm(offered: Dict[str, Any], static_tier: str,
     verdict — same tier on the default pool, then any host arm off the
     process pool — never an arbitrary lexicographic pick (which would
     route to the device or the spawn pool with zero evidence)."""
-    for cand in (costmodel.arm_key(static_tier, chunks, "thread"),
+    for cand in (costmodel.arm_key(static_tier, chunks, "shard"),
+                 costmodel.arm_key(static_tier, chunks, "thread"),
                  costmodel.arm_key(static_tier, chunks, "none")):
         if cand in offered:
             return cand
@@ -130,20 +138,28 @@ def decide(entry, backend: str, n_rows: int, *, op: str, chunks: int,
     to its impl (built by ``api._route_candidates``); ``static`` is the
     static-gate verdict ``(tier, impl, reason)`` — the autotune-off
     behavior and the cold-start policy."""
-    from .pool import pool_mode, process_available
+    from .pool import pool_mode, process_available, shard_available
 
     tier_s, impl_s, reason_s = static
     schema = entry.fingerprint
     band = costmodel.row_band(n_rows)
     autotune = costmodel.autotune_enabled()
     proc_ok = process_available()
-    static_pool = (pool_mode() if tier_s != "device" and chunks > 1
-                   else "none")
+    shard_ok = shard_available()
+    static_pool = "none"
+    if tier_s != "device" and chunks > 1:
+        static_pool = pool_mode()
+        # the shard runner is the native tier's DEFAULT fan-out when
+        # the binary carries it (one native call beats N GIL-crossing
+        # chunk calls); an explicit PYRUHVRO_TPU_POOL=process keeps the
+        # operator's spawn-pool choice
+        if tier_s == "native" and static_pool == "thread" and shard_ok:
+            static_pool = "shard"
     static_arm = costmodel.arm_key(tier_s, chunks, static_pool)
 
     arms: Dict[str, Tuple[str, Any, str]] = {}
     for tier, impl in candidates.items():
-        for p in _pools_for(tier, chunks, proc_ok):
+        for p in _pools_for(tier, chunks, proc_ok, shard_ok):
             arms[costmodel.arm_key(tier, chunks, p)] = (tier, impl, p)
     arms.setdefault(static_arm, (tier_s, impl_s, static_pool))
     predicted = {a: costmodel.predict(schema, op, band, a, n_rows)

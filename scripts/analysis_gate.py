@@ -86,11 +86,14 @@ _SAN_SUITES = (
 # the TSan leg (ISSUE 14): the native differentials again — this time
 # hunting data races, not memory bugs — plus the explicitly-threaded
 # legs of the concurrency suite (concurrent native decode/encode over
-# the GIL-released VM, the exact shape ROADMAP item 3 will make hotter)
+# the GIL-released VM) and, since r17, the shard-runner differential
+# suite — the in-native thread pool fanning one call across per-shard
+# arenas is exactly the surface a race would hide in
 _TSAN_SUITES = (
     ("tests/test_native_extract.py", ()),
     ("tests/test_fused_decode.py", ()),
     ("tests/test_concurrency.py", ("-k", "threaded")),
+    ("tests/test_shard_runner.py", ()),
 )
 
 

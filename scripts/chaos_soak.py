@@ -167,6 +167,18 @@ def main() -> int:
     [enc_ref] = p.serialize_record_batch(ref, KAFKA_SCHEMA_JSON, 1,
                                          backend="host")
 
+    # shard_worker cells need the 400-row corpus to reach the one-call
+    # native fan-out: drop the large-batch gate for the whole soak
+    shard_seam = False
+    if native_available():
+        from pyruhvro_tpu.hostpath.codec import NativeHostCodec
+        from pyruhvro_tpu.runtime.native.build import load_host_codec
+
+        mod = load_host_codec()
+        if mod is not None and hasattr(mod, "shard_stats"):
+            NativeHostCodec._PER_CHUNK_ROWS = 64
+            shard_seam = True
+
     ledger: list = []
     ok = True
     for rnd in range(args.rounds):
@@ -194,6 +206,27 @@ def main() -> int:
                     timeout_s=d),
                 check=lambda out: sum(b.num_rows for b in out) == len(
                     data))
+            # one-call native shard-runner seam (ISSUE 17): a struck
+            # worker degrades the fan-out to the retained serial
+            # per-chunk loop (rows identical); a hang stops at the
+            # per-chunk deadline checkpoint; the native_shards breaker
+            # must re-admit once the spec clears
+            if shard_seam:
+                ok &= Cell(ledger, "shard_worker", kind,
+                           "decode_threaded", "raise", dl).run(
+                    lambda d=dl: p.deserialize_array_threaded(
+                        data, KAFKA_SCHEMA_JSON, 4, backend="host",
+                        timeout_s=d),
+                    check=lambda out: sum(
+                        b.num_rows for b in out) == len(data))
+                ok &= Cell(ledger, "shard_worker", kind,
+                           "encode_threaded", "raise", dl).run(
+                    lambda d=dl: p.serialize_record_batch(
+                        ref, KAFKA_SCHEMA_JSON, 4, backend="host",
+                        timeout_s=d),
+                    check=lambda out: sum(len(a) for a in out) == len(
+                        data))
+                ok &= _recover("native_shards")
             # fused-extract encode seam
             ok &= Cell(ledger, "native_extract", kind, "encode", "raise",
                        dl).run(

@@ -120,6 +120,60 @@ def single10m(rows: int) -> None:
             "fused": f_hit, "fallback": f_fb,
             "hit_rate": round(f_hit / (f_hit + f_fb), 4),
         }} if (f_hit or f_fb) else {}),
+        # one-call native shard runner (ISSUE 17): >0 ⇒ the chunked
+        # host calls above went through the single-native-call fan-out
+        "shard_native_calls": int(snap.get("shard.native", 0)),
+    })
+
+
+def host_shard_1m(rows: int, chunks: int = 8) -> None:
+    """The shard-runner headline (ISSUE 17): kafka rows × ``chunks``
+    through the host tier's ONE-CALL native fan-out — the wall the PR 9
+    serial per-chunk loop is compared against. Records the runner's own
+    drained busy/wall counters as ``chunk_efficiency`` (the figure
+    BENCH_NOTES.md says to quote) and how many native shard calls
+    actually served the run (0 ⇒ the path degraded; the number is then
+    NOT a shard-runner number)."""
+    from pyruhvro_tpu import deserialize_array_threaded, serialize_record_batch
+    import pyarrow as pa
+
+    from pyruhvro_tpu.runtime import metrics as _metrics
+
+    _warm_routing()
+    datums = _gen(rows)
+    deserialize_array_threaded(datums[:4096], _schema(), chunks,
+                               backend="host")  # warm the arm
+    walls = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        batches = deserialize_array_threaded(datums, _schema(), chunks,
+                                             backend="host")
+        walls.append(time.perf_counter() - t0)
+        assert sum(b.num_rows for b in batches) == rows
+    walls.sort()
+    dt_de = walls[len(walls) // 2]
+    snap = _metrics.snapshot()
+    whole = pa.Table.from_batches(batches).combine_chunks().to_batches()[0]
+    t0 = time.perf_counter()
+    arrays = serialize_record_batch(whole, _schema(), chunks,
+                                    backend="host")
+    dt_en = time.perf_counter() - t0
+    assert sum(len(a) for a in arrays) == rows
+    eff = None
+    effs = snap.get("pool.eff_fanouts", 0)
+    if effs:
+        eff = round(snap.get("pool.chunk_efficiency", 0.0) / effs, 4)
+    _record({
+        "mode": "host_shard_1m", "rows": rows, "chunks": chunks,
+        "decode_s": round(dt_de, 3),
+        "decode_rec_s": round(rows / dt_de, 1),
+        "decode_vs_baseline": round(rows / dt_de / BASELINE_DECODE, 4),
+        "encode_s": round(dt_en, 3),
+        "encode_rec_s": round(rows / dt_en, 1),
+        "shard_native_calls": int(snap.get("shard.native", 0)),
+        "shard_fallbacks": int(snap.get("shard.fallback", 0)),
+        **({"chunk_efficiency": eff} if eff is not None else {}),
+        "machine": {"cpus": os.cpu_count()},
     })
 
 
@@ -257,12 +311,15 @@ def _pa():
 
 if __name__ == "__main__":
     ap = argparse.ArgumentParser()
-    ap.add_argument("--mode", choices=("single10m", "roundtrip100m", "mesh"),
+    ap.add_argument("--mode", choices=("single10m", "host_shard_1m",
+                                       "roundtrip100m", "mesh"),
                     required=True)
     ap.add_argument("--rows", type=int, default=None)
     a = ap.parse_args()
     if a.mode == "single10m":
         single10m(a.rows or 10_000_000)
+    elif a.mode == "host_shard_1m":
+        host_shard_1m(a.rows or 1_000_000)
     elif a.mode == "roundtrip100m":
         roundtrip100m(a.rows or 100_000_000)
     else:

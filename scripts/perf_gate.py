@@ -566,6 +566,52 @@ def device_warm_check() -> dict:
     return out
 
 
+def shard_efficiency_check() -> dict:
+    """Native shard-runner contract: on a ≥4-core box a 4-thread
+    one-call decode must overlap its shards at ``chunk_efficiency`` ≥
+    0.6 (busy / (wall × threads), from the runner's OWN drained
+    counters — the figure Python-side serialization can't fake). On
+    fewer cores the check skips with a note: the pool still fans out
+    (time-sliced) but parallel efficiency is not a property this box
+    can witness."""
+    cores = os.cpu_count() or 1
+    out = {"cores": cores, "threads": 4}
+    if cores < 4:
+        out.update({
+            "skipped": True, "pass": True,
+            "note": f"needs a >=4-core box to witness parallel shard "
+                    f"overlap; this host has {cores}",
+        })
+        return out
+    from pyruhvro_tpu.hostpath.codec import NativeHostCodec
+    from pyruhvro_tpu.schema.cache import get_or_parse_schema
+    from pyruhvro_tpu.utils.datagen import (
+        KAFKA_SCHEMA_JSON,
+        kafka_style_datums,
+    )
+
+    e = get_or_parse_schema(KAFKA_SCHEMA_JSON)
+    codec = NativeHostCodec(e.ir, e.arrow_schema)
+    if not hasattr(codec._mod, "shard_stats"):
+        out.update({"skipped": True, "pass": True,
+                    "note": "host_codec binary predates the shard runner"})
+        return out
+    base = kafka_style_datums(50_000, seed=7)
+    datums = (base * 10)[:500_000]
+    codec.decode(datums[:1000])  # warm
+    eff = 0.0
+    for _ in range(2):
+        codec._drain_shard_stats()
+        codec.decode(datums, nthreads=4)
+        d = codec._drain_shard_stats()
+        if d["fanouts"] and d["wall_s"] > 0 and d["threads"]:
+            eff = max(eff, min(1.0, d["shard_s"]
+                               / (d["wall_s"] * d["threads"])))
+    out["chunk_efficiency"] = round(eff, 4)
+    out["pass"] = eff >= 0.6
+    return out
+
+
 def main(argv: Optional[list] = None) -> int:
     ap = argparse.ArgumentParser(
         prog="perf_gate.py",
@@ -605,6 +651,11 @@ def main(argv: Optional[list] = None) -> int:
                     help="skip the warm-device contract check (ISSUE 10:"
                          " zero retries, all-hit jit cache, overlap "
                          "fraction > 0 on a warm forced-device call)")
+    ap.add_argument("--no-shard-check", action="store_true",
+                    help="skip the native shard-runner efficiency check "
+                         "(chunk_efficiency >= 0.6 at 4 threads on a "
+                         ">=4-core box; auto-skips with a note on "
+                         "smaller hosts)")
     ap.add_argument("--slo-file",
                     default=os.environ.get("PYRUHVRO_TPU_SLO_FILE"),
                     help="evaluate this SLO file over the gate run: the "
@@ -733,6 +784,23 @@ def main(argv: Optional[list] = None) -> int:
              f"overlap_frac={dev_warm.get('overlap_frac')} -> "
              f"{'ok' if dev_warm['pass'] else 'FAILED'}")
         failed = failed or not dev_warm["pass"]
+    # native shard-runner efficiency contract: the one-call fan-out
+    # must genuinely overlap its shards where the hardware can show it
+    shard_eff = None
+    if not args.details and not args.no_shard_check:
+        try:
+            shard_eff = shard_efficiency_check()
+        except Exception as e:  # noqa: BLE001 — named failure below
+            _log(f"[perf-gate] shard efficiency check errored: {e!r}")
+            shard_eff = {"pass": False, "error": repr(e)}
+        if shard_eff.get("skipped"):
+            _log(f"[perf-gate] shard efficiency check: skipped "
+                 f"({shard_eff.get('note')})")
+        else:
+            _log(f"[perf-gate] shard efficiency check: "
+                 f"eff={shard_eff.get('chunk_efficiency')} @ 4 threads "
+                 f"-> {'ok' if shard_eff['pass'] else 'FAILED (<0.6)'}")
+        failed = failed or not shard_eff["pass"]
     # fused-decode coverage budget (ISSUE 9): when the native tier
     # served the kafka case, at least 95% of its decode calls must have
     # gone through the fused wire→Arrow pass — a creeping fallback rate
@@ -768,6 +836,8 @@ def main(argv: Optional[list] = None) -> int:
         "pass": not failed,
         "cases": {k: round(m, 6) for k, m, _a, _r in rows},
         **({"device_warm": dev_warm} if dev_warm is not None else {}),
+        **({"shard_efficiency": shard_eff} if shard_eff is not None
+           else {}),
     }))
     return 1 if failed else 0
 

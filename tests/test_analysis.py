@@ -108,7 +108,7 @@ def test_contracts_catch_slot_name_drift(fixture_tree):
 
 def test_contracts_catch_pseudo_slot_drift(fixture_tree):
     fixture_tree.mutate("pyruhvro_tpu/runtime/native/host_vm_core.h",
-                        "P_COLLECT = 16,", "P_COLLECT = 17,")
+                        "P_COLLECT = 17,", "P_COLLECT = 16,")
     fs = contracts.check_contracts(str(fixture_tree), generative=False)
     assert any(f.rule == "contract.prof-slots" for f in fs), fs
 

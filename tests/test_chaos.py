@@ -228,6 +228,112 @@ def test_vm_decode_fault_threaded_fallback_chunks(chaos):
     assert metrics.snapshot().get("route.native_failure", 0) >= 1
 
 
+def _shard_gate(monkeypatch):
+    """Force the large-batch gate low and require a shard-capable
+    binary, so a few hundred rows take the one-call native shard path."""
+    from pyruhvro_tpu.hostpath.codec import NativeHostCodec
+    from pyruhvro_tpu.runtime.native.build import load_host_codec
+
+    mod = load_host_codec()
+    if mod is None or not hasattr(mod, "shard_stats"):
+        pytest.skip("host_codec binary predates the shard runner")
+    monkeypatch.setattr(NativeHostCodec, "_PER_CHUNK_ROWS", 64)
+
+
+@NEED_NATIVE
+def test_shard_worker_fault_degrades_to_serial_loop(chaos, monkeypatch):
+    """An injected shard_worker fault costs the ONE-CALL fan-out, not
+    the call: the retained serial per-chunk loop serves identical rows
+    and the native_shards breaker counts the strike."""
+    _shard_gate(monkeypatch)
+    data = kafka_style_datums(512, seed=21)
+    ref = p.deserialize_array_threaded(data, KAFKA_SCHEMA_JSON, 4,
+                                       backend="host")
+    assert metrics.snapshot().get("shard.native", 0) >= 1
+    telemetry.reset()
+    chaos("shard_worker:error:1")
+    out = p.deserialize_array_threaded(data, KAFKA_SCHEMA_JSON, 4,
+                                       backend="host")
+    assert all(a.equals(b) for a, b in zip(out, ref))
+    c = metrics.snapshot()
+    assert c.get("fault.injected.shard_worker", 0) >= 1, c
+    assert c.get("shard.fallback_fault", 0) >= 1, c
+    assert c.get("shard.native", 0) == 0, c
+
+
+@NEED_NATIVE
+def test_shard_worker_fault_opens_breaker_then_recovers(
+        chaos, monkeypatch):
+    """Repeated shard_worker strikes open the ``native_shards`` breaker
+    (one-call path withheld WITHOUT paying the fault seam); a reset +
+    healthy call re-admits the shard runner."""
+    _shard_gate(monkeypatch)
+    data = kafka_style_datums(300, seed=22)
+    chaos("shard_worker:error:1")
+    br = breaker.get("native_shards")
+    for _ in range(br.threshold()):
+        p.deserialize_array_threaded(data, KAFKA_SCHEMA_JSON, 4,
+                                     backend="host")
+    assert br.state() == "open"
+    telemetry.reset()
+    out = p.deserialize_array_threaded(data, KAFKA_SCHEMA_JSON, 4,
+                                       backend="host")
+    assert sum(b.num_rows for b in out) == 300
+    c = metrics.snapshot()
+    # the open breaker withholds the arm BEFORE the fault seam: either
+    # the router never offered it (no shard counters at all) or the
+    # codec short-circuited on acquire — never a native shard call
+    assert c.get("shard.native", 0) == 0, c
+    assert c.get("fault.injected.shard_worker", 0) == 0, c
+    chaos("")
+    breaker.reset()
+    telemetry.reset()
+    out = p.deserialize_array_threaded(data, KAFKA_SCHEMA_JSON, 4,
+                                       backend="host")
+    assert sum(b.num_rows for b in out) == 300
+    assert metrics.snapshot().get("shard.native", 0) >= 1
+
+
+@NEED_NATIVE
+def test_shard_worker_hang_hits_per_chunk_deadline(chaos, monkeypatch):
+    """A hanging shard worker cannot outlive the call budget: the
+    per-chunk seam checkpoints BEFORE the uninterruptible native call,
+    so the expiry stops at a chunk boundary with the host seam's site
+    tag — and the breaker is released, not wedged half-acquired."""
+    _shard_gate(monkeypatch)
+    data = kafka_style_datums(400, seed=23)
+    chaos("shard_worker:hang:1", hang_s=0.4)
+    t0 = time.monotonic()
+    with pytest.raises(DeadlineExceeded) as ei:
+        p.deserialize_array_threaded(data, KAFKA_SCHEMA_JSON, 4,
+                                     backend="host", timeout_s=0.15)
+    assert time.monotonic() - t0 < 5.0
+    assert ei.value.site == "host.chunk", ei.value.site
+    # the expiry path released (not failed) the breaker: the next
+    # healthy call goes straight back through the one-call fan-out
+    chaos("")
+    telemetry.reset()
+    p.deserialize_array_threaded(data, KAFKA_SCHEMA_JSON, 4,
+                                 backend="host")
+    assert metrics.snapshot().get("shard.native", 0) >= 1
+
+
+@NEED_NATIVE
+def test_shard_worker_fault_encode_degrades(chaos, monkeypatch):
+    """The encode leg shares the seam: a strike degrades the one-call
+    sharded encode to the retained per-chunk fan-out, byte-identical."""
+    _shard_gate(monkeypatch)
+    data = kafka_style_datums(300, seed=24)
+    batch = p.deserialize_array(data, KAFKA_SCHEMA_JSON, backend="host")
+    chaos("shard_worker:error:1")
+    out = p.serialize_record_batch(batch, KAFKA_SCHEMA_JSON, 4,
+                                   backend="host")
+    flat = [bytes(x) for arr in out for x in arr]
+    assert flat == data
+    c = metrics.snapshot()
+    assert c.get("fault.injected.shard_worker", 0) >= 1, c
+
+
 @NEED_NATIVE
 def test_native_extract_fault_encode_parity_and_breaker_recovery(
         chaos, monkeypatch):
@@ -862,6 +968,9 @@ def test_halfopen_process_probes_ride_the_explore_schedule(monkeypatch):
     monkeypatch.setenv("PYRUHVRO_TPU_AUTOTUNE", "1")
     monkeypatch.setenv("PYRUHVRO_TPU_EXPLORE", "0.25")
     monkeypatch.setenv("PYRUHVRO_TPU_ROUTING_PROFILE", "")
+    # keep the shard arm out of the explore rotation: this cell is
+    # about the PROCESS probe riding the schedule
+    monkeypatch.setenv("PYRUHVRO_TPU_NO_NATIVE_SHARDS", "1")
     from pyruhvro_tpu.runtime import costmodel, router
 
     br = breaker.get("process_pool")

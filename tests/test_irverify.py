@@ -114,7 +114,8 @@ def test_mutation_selftest_all_caught(full_run):
     _, report = full_run
     assert report["mutation"]["all_caught"] is True
     classes = {c["class"] for c in report["mutation"]["cases"]}
-    assert classes == {"effect", "progress", "overflow", "equiv"}
+    assert classes == {"effect", "progress", "overflow", "equiv",
+                       "optimize"}
     for case in report["mutation"]["cases"]:
         assert case["caught"], case
 

@@ -153,6 +153,9 @@ def test_encode_threaded_pool_rows_reconcile(monkeypatch):
     data = kafka_style_datums(256, seed=5)
     batch = deserialize_array(data, KAFKA_SCHEMA_JSON, backend="host")
     monkeypatch.setattr(NativeHostCodec, "_PER_CHUNK_ROWS", 16)
+    # the one-call native shard runner would swallow the fan-out whole
+    # (no per-chunk pool workers) — this cell is about POOL accounting
+    monkeypatch.setenv("PYRUHVRO_TPU_NO_NATIVE_SHARDS", "1")
     telemetry.reset()
     arrs = serialize_record_batch(batch, KAFKA_SCHEMA_JSON, 4,
                                   backend="host")

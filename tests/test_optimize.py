@@ -1,0 +1,233 @@
+"""Optimizer differential suite (superoptimizer, ``hostpath/optimize.py``).
+
+Every accepted rewrite is proved by the irverify equivalence oracle at
+build time; these tests re-check the claim empirically — 100 random
+schemas decoded AND encoded through the optimized program must be
+byte-identical to the unoptimized path, on both the generic VM and the
+schema-specialized engines — and prove the oracle itself has teeth by
+planting deliberately-wrong rewrites that it must catch red.
+"""
+
+import copy
+import os
+
+import numpy as np
+import pytest
+
+from pyruhvro_tpu.analysis import irverify
+from pyruhvro_tpu.fallback.decoder import decode_to_record_batch
+from pyruhvro_tpu.hostpath import NativeHostCodec, native_available
+from pyruhvro_tpu.hostpath import program as hp
+from pyruhvro_tpu.hostpath.optimize import (
+    optimize_program,
+    strip_optimizations,
+)
+from pyruhvro_tpu.hostpath.program import lower_host
+from pyruhvro_tpu.schema.cache import get_or_parse_schema
+from pyruhvro_tpu.utils.datagen import (
+    KAFKA_SCHEMA_JSON,
+    kafka_style_datums,
+    random_datums,
+    random_schema,
+)
+
+pytestmark = pytest.mark.skipif(
+    not native_available(), reason="native toolchain unavailable"
+)
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# a shape the fuser provably rewrites: a run of fixed-width leaves, a
+# nullable sub-record with its own run, and a string to break the runs
+RUN_SCHEMA = """
+{"type": "record", "name": "OptRun", "fields": [
+  {"name": "x", "type": "double"},
+  {"name": "y", "type": "float"},
+  {"name": "k", "type": "boolean"},
+  {"name": "tag", "type": "string"},
+  {"name": "opt", "type": ["null", {"type": "record", "name": "OInner",
+    "fields": [{"name": "p", "type": "double"},
+               {"name": "q", "type": "double"}]}]}
+]}
+"""
+
+
+@pytest.fixture(scope="module")
+def guards():
+    return irverify.scan_native_guards(ROOT)
+
+
+@pytest.fixture(scope="module")
+def consumers():
+    return irverify.scan_aux_consumers(ROOT)
+
+
+def _raw_codec(monkeypatch, schema):
+    monkeypatch.setenv("PYRUHVRO_TPU_NO_OPT", "1")
+    e = get_or_parse_schema(schema)
+    return NativeHostCodec(e.ir, e.arrow_schema)
+
+
+# ---------------------------------------------------------------------------
+# differential: optimized vs unoptimized, generic VM, 100 random schemas
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("seed", range(100))
+def test_optimized_matches_raw_over_random_schemas(seed):
+    """decode AND encode through the optimized program must be
+    byte-identical to the raw program — the empirical leg of the
+    verifier's effect-equality proof."""
+    schema = random_schema(seed)
+    e = get_or_parse_schema(schema)
+    codec = NativeHostCodec(e.ir, e.arrow_schema)
+    datums = random_datums(e.ir, 40, seed=seed + 7000)
+
+    raw = codec.prog
+    opt, stats = optimize_program(raw)
+    assert not stats.rejected, stats.findings
+    # strip is exact inverse on ops, aux and coltypes
+    stripped = strip_optimizations(opt)
+    assert [tuple(r) for r in stripped.ops] == [tuple(r) for r in raw.ops]
+    assert [int(c) for c in stripped.coltypes] == \
+        [int(c) for c in raw.coltypes]
+
+    got = codec.decode(datums)          # generic VM runs codec.oprog
+    want = decode_to_record_batch(datums, e.ir, e.arrow_schema)
+    assert got.equals(want), schema
+    assert [bytes(x) for x in codec.encode(want)] == datums, schema
+
+
+@pytest.mark.parametrize("seed", (3, 17, 41))
+def test_no_opt_knob_pins_raw_program(monkeypatch, seed):
+    """PYRUHVRO_TPU_NO_OPT=1 pins the raw program and both paths still
+    agree byte-for-byte (the explicit optimized-vs-unoptimized leg)."""
+    schema = random_schema(seed)
+    e = get_or_parse_schema(schema)
+    opt_codec = NativeHostCodec(e.ir, e.arrow_schema)
+    raw_codec = _raw_codec(monkeypatch, schema)
+    assert raw_codec.oprog is raw_codec.prog
+    assert raw_codec.opt_stats is None
+
+    datums = random_datums(e.ir, 60, seed=seed + 8000)
+    a = opt_codec.decode(datums)
+    b = raw_codec.decode(datums)
+    assert a.equals(b)
+    assert [bytes(x) for x in opt_codec.encode(a)] == \
+        [bytes(x) for x in raw_codec.encode(b)] == datums
+
+
+def test_fuser_actually_fires_on_run_schema():
+    e = get_or_parse_schema(RUN_SCHEMA)
+    codec = NativeHostCodec(e.ir, e.arrow_schema)
+    if not hasattr(codec._mod, "shard_stats"):
+        pytest.skip("stale host_codec binary: optimizer pinned off")
+    assert codec.opt_stats is not None and codec.opt_stats.applied
+    assert codec.opt_stats.fused_runs >= 2  # x/y/k run + p/q run
+    kinds = [int(r[0]) for r in codec.oprog.ops]
+    assert hp.OP_FIXED_RUN in kinds
+    datums = random_datums(e.ir, 500, seed=5)
+    got = codec.decode(datums)
+    want = decode_to_record_batch(datums, e.ir, e.arrow_schema)
+    assert got.equals(want)
+    assert [bytes(x) for x in codec.encode(want)] == datums
+
+
+def test_kafka_schema_optimizes_and_roundtrips():
+    e = get_or_parse_schema(KAFKA_SCHEMA_JSON)
+    codec = NativeHostCodec(e.ir, e.arrow_schema)
+    datums = kafka_style_datums(800, seed=11)
+    got = codec.decode(datums)
+    want = decode_to_record_batch(datums, e.ir, e.arrow_schema)
+    assert got.equals(want)
+    assert [bytes(x) for x in codec.encode(want)] == datums
+
+
+# ---------------------------------------------------------------------------
+# differential: specialized engines (raw-program source of truth)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("seed", (2, 9))
+def test_specialized_engine_agrees_with_optimized_generic(
+        monkeypatch, seed):
+    """The specializer compiles from the RAW program; its output must
+    equal the optimized generic VM's (two independent walks over the
+    same effects)."""
+    schema = random_schema(seed)
+    e = get_or_parse_schema(schema)
+    generic = NativeHostCodec(e.ir, e.arrow_schema)
+    monkeypatch.setenv("PYRUHVRO_TPU_SPECIALIZE_ROWS", "0")
+    monkeypatch.delenv("PYRUHVRO_TPU_NO_SPECIALIZE", raising=False)
+    spec = NativeHostCodec(e.ir, e.arrow_schema)
+
+    datums = random_datums(e.ir, 200, seed=seed + 9000)
+    want = generic.decode(datums)
+    got = spec.decode(datums)
+    assert spec._spec is not None, "specialization did not engage"
+    assert got.equals(want)
+    assert [bytes(x) for x in spec.encode(got)] == \
+        [bytes(x) for x in generic.encode(want)] == datums
+
+
+# ---------------------------------------------------------------------------
+# the oracle has teeth: planted-wrong rewrites must come back red
+# ---------------------------------------------------------------------------
+
+
+def _opt_program():
+    e = get_or_parse_schema(RUN_SCHEMA)
+    raw = lower_host(e.ir)
+    opt, _ = optimize_program(raw, verify=False)
+    assert any(int(r[0]) == hp.OP_FIXED_RUN for r in opt.ops)
+    return raw, opt
+
+
+def _mutate(opt, fn):
+    mut = copy.deepcopy(opt)
+    ops = np.array(mut.ops, dtype=np.int32, copy=True)
+    fn(ops)
+    mut.ops = ops
+    return mut
+
+
+def _run_pcs(ops):
+    return [i for i, r in enumerate(ops) if int(r[0]) == hp.OP_FIXED_RUN]
+
+
+@pytest.mark.parametrize("name,mutfn", [
+    ("span_tamper", lambda ops: ops.__setitem__(
+        (_run_pcs(ops)[0], 2), ops[_run_pcs(ops)[0]][2] + 1)),
+    ("member_reorder", lambda ops: ops.__setitem__(
+        [_run_pcs(ops)[0] + 1, _run_pcs(ops)[0] + 2],
+        ops[[_run_pcs(ops)[0] + 2, _run_pcs(ops)[0] + 1]])),
+    ("always_present_overclaim", lambda ops: ops.__setitem__(
+        (_run_pcs(ops)[-1], 5),
+        ops[_run_pcs(ops)[-1]][5] | hp.FLAG_ALWAYS_PRESENT)),
+])
+def test_planted_bad_rewrite_is_caught(guards, consumers, name, mutfn):
+    raw, opt = _opt_program()
+    # sanity: the honest rewrite passes the oracle clean
+    assert irverify.verify_optimized(raw, opt, guards, consumers) == []
+    bad = _mutate(opt, mutfn)
+    findings = irverify.verify_optimized(raw, bad, guards, consumers)
+    assert findings, f"oracle missed planted rewrite {name!r}"
+    assert any(f.rule.startswith("irverify.") for f in findings)
+
+
+def test_rejected_rewrite_is_counted_never_run(monkeypatch):
+    """If the oracle rejects, optimize_program must return the RAW
+    program untouched and count the rejection."""
+    import pyruhvro_tpu.hostpath.optimize as hopt
+
+    e = get_or_parse_schema(RUN_SCHEMA)
+    raw = lower_host(e.ir)
+
+    def always_red(orig, opt, guards, consumers, label="optimized"):
+        return [irverify.Finding("irverify.optimize", label, "planted")]
+
+    monkeypatch.setattr(irverify, "verify_optimized", always_red)
+    prog, stats = hopt.optimize_program(raw)
+    assert stats.rejected
+    assert prog is raw
+    assert [tuple(r) for r in prog.ops] == [tuple(r) for r in raw.ops]

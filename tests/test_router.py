@@ -143,7 +143,10 @@ def _static_native(chunks):
     return (tier, impl, reason), {tier: impl}
 
 
-def test_cold_start_is_the_static_verdict(autotune):
+def test_cold_start_is_the_static_verdict(autotune, monkeypatch):
+    # pin the historic thread pool: whether the shard arm is offered
+    # depends on which host_codec binary happens to be warm in-process
+    monkeypatch.setenv("PYRUHVRO_TPU_NO_NATIVE_SHARDS", "1")
     static, cands = _static_native(4)
     dec = router.decide(_entry(), "host", 1000, op="decode", chunks=4,
                         candidates=cands, static=static)
@@ -266,6 +269,7 @@ def test_penalty_expires(autotune):
 
 def test_autotune_off_is_static_bit_for_bit(monkeypatch):
     monkeypatch.delenv("PYRUHVRO_TPU_AUTOTUNE", raising=False)
+    monkeypatch.setenv("PYRUHVRO_TPU_NO_NATIVE_SHARDS", "1")
     entry = _entry()
     static, cands = _static_native(4)
     # even with overwhelming evidence for the process arm, off = static
@@ -379,10 +383,12 @@ def test_load_save_cycle_is_idempotent(tmp_path):
     assert e2["n"] == pytest.approx(101.0)
 
 
-def test_cold_start_fallback_avoids_device_and_process(autotune):
+def test_cold_start_fallback_avoids_device_and_process(autotune,
+                                                       monkeypatch):
     """Static arm withheld (storm penalty) + cold model: the fallback
     must be the nearest safe arm, never a lexicographic accident that
     lands on the device or the spawn pool."""
+    monkeypatch.setenv("PYRUHVRO_TPU_NO_NATIVE_SHARDS", "1")
     entry = _entry()
     _tier, impl, _reason = _route(entry, "host", 1000)
     cands = {"device": object(), "native": impl}

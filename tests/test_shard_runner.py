@@ -1,0 +1,227 @@
+"""Native shard-runner tests (one-call chunk fan-out, host tier).
+
+The C++ pool (``runtime/native/shard_runner.h``) moves the chunked
+decode/encode fan-out INSIDE one native call: persistent workers shard
+the row range over per-shard arenas, the fused merge rebases offsets
+and validity, and Python only slices the finished batch per chunk.
+These tests pin the differential contract (one-call output ==
+retained serial per-chunk loop, byte-for-byte on encode), the drained
+busy/wall counters feeding ``pool.chunk_efficiency``, the breaker /
+knob degradations back to the serial loop, and the router's
+``native/shard`` arm.
+
+This box may report a single CPU — auto thread selection then stays
+serial by design, so pool-mechanics tests pass explicit thread counts.
+"""
+
+import json
+
+import pytest
+
+from pyruhvro_tpu import deserialize_array_threaded, telemetry
+from pyruhvro_tpu.api import _route
+from pyruhvro_tpu.hostpath import native_available
+from pyruhvro_tpu.hostpath.codec import NativeHostCodec
+from pyruhvro_tpu.runtime import breaker, costmodel, metrics, router
+from pyruhvro_tpu.runtime.pool import fanout_stats, shard_available
+from pyruhvro_tpu.schema.cache import get_or_parse_schema
+from pyruhvro_tpu.utils.datagen import (
+    KAFKA_SCHEMA_JSON,
+    kafka_style_datums,
+)
+
+pytestmark = pytest.mark.skipif(
+    not native_available(), reason="native toolchain unavailable"
+)
+
+
+def _codec():
+    e = get_or_parse_schema(KAFKA_SCHEMA_JSON)
+    c = NativeHostCodec(e.ir, e.arrow_schema)
+    if not hasattr(c._mod, "shard_stats"):
+        pytest.skip("host_codec binary predates the shard runner")
+    return c
+
+
+@pytest.fixture
+def small_gate(monkeypatch):
+    """Drop the large-batch gate so a few hundred rows engage the
+    one-call shard path instead of the slice mode."""
+    monkeypatch.setattr(NativeHostCodec, "_PER_CHUNK_ROWS", 64)
+
+
+# ---------------------------------------------------------------------------
+# differential: one native call == retained serial per-chunk loop
+# ---------------------------------------------------------------------------
+
+
+def test_decode_one_call_matches_serial_loop(small_gate):
+    c = _codec()
+    datums = kafka_style_datums(512, seed=3)
+    native = c.decode_threaded(datums, 4)
+    assert metrics.snapshot().get("shard.native", 0) >= 1
+    serial = c.decode_threaded(datums, 4, pool="thread")
+    assert len(native) == len(serial) == 4
+    for a, b in zip(native, serial):
+        assert a.equals(b)
+
+
+def test_encode_one_call_matches_serial_loop(small_gate):
+    c = _codec()
+    datums = kafka_style_datums(512, seed=7)
+    batch = c.decode(datums)
+    native = c.encode_threaded(batch, 4)
+    shard_hits = metrics.snapshot().get("shard.native", 0)
+    serial = c.encode_threaded(batch, 4, pool="thread")
+    flat = [bytes(x) for arr in native for x in arr]
+    assert flat == [bytes(x) for arr in serial for x in arr] == datums
+    if shard_hits == 0:
+        # the Arrow-native extract lane may decline a shape; then the
+        # one-call path degrades and both sides ran the retained path
+        assert metrics.snapshot().get("shard.fallback", 0) >= 1
+
+
+def test_annotates_native_shard_chunk_mode(small_gate):
+    _codec()
+    datums = kafka_style_datums(256, seed=9)
+    deserialize_array_threaded(datums, KAFKA_SCHEMA_JSON, 4,
+                               backend="host")
+    root = telemetry.snapshot()["spans"][-1]
+    assert root["attrs"].get("chunk_mode") == "native_shard"
+
+
+# ---------------------------------------------------------------------------
+# the C++ pool itself: explicit fan-out, drained counters, env cap
+# ---------------------------------------------------------------------------
+
+
+def test_pool_fans_out_and_drains_counters():
+    c = _codec()
+    datums = kafka_style_datums(2000, seed=5)
+    c._drain_shard_stats()  # discard other tests' counters
+    sharded = c.decode(datums, nthreads=4)
+    d = c._drain_shard_stats()
+    assert d["fanouts"] == 1
+    assert d["shards"] == 4
+    assert d["threads"] == 4
+    assert d["wall_s"] > 0.0
+    assert d["shard_s"] > 0.0  # summed shard busy (1-core boxes may
+    #                            context-switch below one wall)
+    # drain clears: a second snapshot reads zeros
+    z = c._drain_shard_stats()
+    assert z["fanouts"] == 0 and z["shards"] == 0
+    # fused merge rebased offsets/validity: identical to the serial VM
+    assert sharded.equals(c.decode(datums, nthreads=1))
+
+
+def test_shard_threads_env_cap_forces_serial(monkeypatch):
+    c = _codec()
+    datums = kafka_style_datums(1000, seed=6)
+    monkeypatch.setenv("PYRUHVRO_TPU_SHARD_THREADS", "1")
+    c._drain_shard_stats()
+    got = c.decode(datums, nthreads=4)  # cap wins over the request
+    assert c._drain_shard_stats()["fanouts"] == 0
+    monkeypatch.delenv("PYRUHVRO_TPU_SHARD_THREADS")
+    assert got.equals(c.decode(datums, nthreads=4))
+
+
+def test_native_counters_feed_chunk_efficiency():
+    """The drained busy/wall counters become ``pool.chunk_efficiency``
+    through ``fanout_stats.native_fanout`` — the native path's analogue
+    of the serial loop's per-chunk timings."""
+    with fanout_stats(4, native=True) as stats:
+        stats.native_fanout(0.38, 0.1, 4)
+    snap = telemetry.snapshot()
+    counters = snap["counters"]
+    assert counters.get("pool.eff_fanouts", 0) >= 1
+    eff = counters["pool.chunk_efficiency"] / counters["pool.eff_fanouts"]
+    assert eff == pytest.approx(0.95)
+    assert "pool.chunk_efficiency" in snap["histograms"]
+
+
+# ---------------------------------------------------------------------------
+# degradations: breaker, knob, stale binary
+# ---------------------------------------------------------------------------
+
+
+def test_open_breaker_degrades_to_serial_loop(small_gate):
+    c = _codec()
+    datums = kafka_style_datums(300, seed=8)
+    breaker.get("native_shards").force_open()
+    out = c.decode_threaded(datums, 4)
+    snap = metrics.snapshot()
+    assert snap.get("shard.breaker_open", 0) >= 1
+    assert snap.get("shard.native", 0) == 0
+    serial = c.decode_threaded(datums, 4, pool="thread")
+    for a, b in zip(out, serial):
+        assert a.equals(b)
+
+
+def test_no_native_shards_knob_pins_serial_loop(small_gate, monkeypatch):
+    c = _codec()
+    monkeypatch.setenv("PYRUHVRO_TPU_NO_NATIVE_SHARDS", "1")
+    assert not c._native_shards_usable()
+    assert not shard_available()
+    c.decode_threaded(kafka_style_datums(300, seed=4), 4)
+    assert metrics.snapshot().get("shard.native", 0) == 0
+
+
+def test_shard_available_tracks_breaker(monkeypatch):
+    _codec()  # warm the shard-capable module
+    monkeypatch.delenv("PYRUHVRO_TPU_NO_NATIVE_SHARDS", raising=False)
+    assert shard_available()
+    breaker.get("native_shards").force_open()
+    assert not shard_available()
+    breaker.reset()
+    assert shard_available()
+
+
+# ---------------------------------------------------------------------------
+# router: the native/shard arm
+# ---------------------------------------------------------------------------
+
+_R_SCHEMA = json.dumps({
+    "type": "record", "name": "ShardRoute",
+    "fields": [{"name": "a", "type": "long"},
+               {"name": "b", "type": "string"}],
+})
+
+
+def test_router_static_pool_prefers_shard(monkeypatch):
+    _codec()  # the arm is offered only once the binary is warm
+    monkeypatch.setenv("PYRUHVRO_TPU_AUTOTUNE", "1")
+    monkeypatch.setenv("PYRUHVRO_TPU_EXPLORE", "0")
+    monkeypatch.setenv("PYRUHVRO_TPU_ROUTING_PROFILE", "")
+    entry = get_or_parse_schema(_R_SCHEMA)
+    static = _route(entry, "host", 1000)
+    assert static[0] == "native"
+    dec = router.decide(entry, "host", 1000, op="decode", chunks=4,
+                        candidates={static[0]: static[1]}, static=static)
+    assert dec.tier == "native" and dec.pool == "shard"
+    # the knob removes the arm and restores the historic thread pool
+    monkeypatch.setenv("PYRUHVRO_TPU_NO_NATIVE_SHARDS", "1")
+    dec = router.decide(entry, "host", 1000, op="decode", chunks=4,
+                        candidates={static[0]: static[1]}, static=static)
+    assert dec.pool == "thread"
+
+
+def test_shard_arm_in_offer_space(monkeypatch):
+    _codec()
+    monkeypatch.delenv("PYRUHVRO_TPU_NO_NATIVE_SHARDS", raising=False)
+    arms = router._pools_for("native", 4, proc_ok=False, shard_ok=True)
+    assert arms[0] == "shard" and "thread" in arms
+    assert "shard" not in router._pools_for("fallback", 4, proc_ok=False,
+                                            shard_ok=True)
+    assert costmodel.arm_key("native", 4, "shard") == "native/c4/shard"
+
+
+def test_api_end_to_end_routes_native_shard(monkeypatch):
+    """Full API path: the router hands the shard hint to the codec and
+    the batch goes through exactly one native call."""
+    _codec()
+    monkeypatch.setattr(NativeHostCodec, "_PER_CHUNK_ROWS", 64)
+    datums = kafka_style_datums(512, seed=13)
+    out = deserialize_array_threaded(datums, KAFKA_SCHEMA_JSON, 4,
+                                     backend="host")
+    assert sum(b.num_rows for b in out) == 512
+    assert metrics.snapshot().get("shard.native", 0) >= 1
