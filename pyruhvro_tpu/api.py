@@ -51,7 +51,9 @@ from .fallback.decoder import (
 from .fallback.encoder import compile_encoder_plan, encode_record_batch
 from .fallback.io import MalformedAvro, max_datum_bytes, shift_malformed
 from .runtime import (
+    audit,
     breaker,
+    coldigest,
     deadline,
     faults,
     memacct,
@@ -782,6 +784,93 @@ def _proc_map(task, payloads, rows):
         return None
 
 
+# -- differential-audit seams (ISSUE 18) -----------------------------------
+#
+# Called right AFTER router.observe on clean calls, still inside the
+# root span / call_scope / deadline scope: the cost model never sees
+# shadow seconds, the sampler and SLO feed subtract them via the audit
+# TLS, and the caller's deadline bounds the shadow. The shadow always
+# runs the pure-Python oracle — the one tier whose semantics every
+# other tier is contractually equal to.
+
+
+def _audit_shadow_decode(entry, data, bounds, on_error):
+    """Re-decode the SAME rows per chunk through the oracle under the
+    caller's policy; chunk bounds only matter for tolerant index bases
+    (the digests are chunk-insensitive)."""
+    reader = _host_reader(entry)
+    out = []
+    for a, b in bounds:
+        deadline.check(site="audit.shadow")
+        chunk = data[a:b]
+        if on_error == "raise":
+            out.append(decode_to_record_batch(
+                chunk, entry.ir, entry.arrow_schema, reader,
+                index_base=a))
+        else:
+            batch, quar = _tolerant_decode(
+                "fallback", None, entry, chunk, a)
+            out.append(_apply_null_policy(
+                batch, quar, a, b - a, on_error, entry))
+    return out
+
+
+def _audit_shadow_roundtrip(entry, arrays):
+    """The encode shadow: oracle-decode the produced wire bytes back —
+    ``decode(encode(x))`` must equal ``x``."""
+    reader = _host_reader(entry)
+    out = []
+    base = 0
+    for arr in arrays:
+        deadline.check(site="audit.shadow")
+        datums = arr.to_pylist()
+        out.append(decode_to_record_batch(
+            datums, entry.ir, entry.arrow_schema, reader,
+            index_base=base))
+        base += len(datums)
+    return out
+
+
+def _maybe_audit_decode(dec, entry, data, bounds, on_error, result):
+    if not audit.enabled():
+        return
+    batches = result if isinstance(result, list) else [result]
+    audit.maybe_audit(
+        dec, "decode",
+        expected=lambda: batches,
+        shadow=lambda: _audit_shadow_decode(entry, data, bounds,
+                                            on_error),
+        input_fn=lambda: coldigest.input_digest(data),
+        chunks=len(bounds),
+    )
+
+
+def _maybe_audit_encode(dec, entry, batch, bounds, on_error, arrays,
+                        quar):
+    if not audit.enabled():
+        return
+    if quar is None and on_error != "raise":
+        quar = quarantine.last()
+    skip = None
+    if quar:
+        # survivor re-chunking / null re-encode breaks row alignment
+        # between the input batch and the round-trip
+        skip = "quarantine"
+    elif not batch.schema.equals(entry.arrow_schema):
+        # caller-typed batch: digests cover types, not coercions
+        skip = "schema"
+    audit.maybe_audit(
+        dec, "encode",
+        expected=lambda: [batch],
+        shadow=lambda: _audit_shadow_roundtrip(entry, arrays),
+        input_fn=lambda: coldigest.input_digest(batch),
+        result_fn=lambda: (coldigest.array_digest(
+            pa.chunked_array(arrays)) if arrays else ""),
+        chunks=len(bounds),
+        skip_reason=skip,
+    )
+
+
 def deserialize_array(
     data: Sequence[bytes], schema: str, *, backend: str = "auto",
     on_error: str = "raise", return_errors: bool = False,
@@ -854,6 +943,8 @@ def deserialize_array(
             router.observe(dec, error=e)
             raise
         router.observe(dec)
+        _maybe_audit_decode(dec, entry, data, [(0, len(data))],
+                            on_error, out[0] if return_errors else out)
         return out
 
 
@@ -944,6 +1035,8 @@ def deserialize_array_threaded(
             router.observe(dec, error=e)
             raise
         router.observe(dec)
+        _maybe_audit_decode(dec, entry, data, bounds, on_error,
+                            out[0] if return_errors else out)
         return out
 
 
@@ -1116,6 +1209,9 @@ def serialize_record_batch(
             router.observe(dec, error=e)
             raise
         router.observe(dec)
+        _maybe_audit_encode(dec, entry, batch, bounds, on_error,
+                            out[0] if return_errors else out,
+                            out[1] if return_errors else None)
         return out
 
 

@@ -86,6 +86,7 @@ SITES = (
     "flight_dump",      # telemetry.flight_dump file write
     "obs_handler",      # obs_server request handler
     "slo_alert",        # slo alert_command hook
+    "audit_shadow",     # audit: shadow re-execution through the oracle
 )
 
 _KINDS = ("error", "hang", "exit")

@@ -278,6 +278,71 @@ def _merge_memory(snaps: List[Dict[str, Any]]) -> Dict[str, Any]:
     return out
 
 
+def _merge_audit(snaps: List[Dict[str, Any]],
+                 tags: List[str]) -> Dict[str, Any]:
+    """Fold per-replica ``audit`` sections and flag cross-replica
+    divergence: two replicas reporting different *result* digests for
+    the same (schema, op, input-digest, chunks) cannot both be right —
+    one of them is corrupting data, and no single-process audit can see
+    it. The merged section carries a ``divergent`` list naming the
+    disagreeing replicas and their digests."""
+    sections = [(s.get("audit"), tag) for s, tag in zip(snaps, tags)
+                if isinstance(s.get("audit"), dict) and s.get("audit")]
+    if not sections:
+        return {}
+    out: Dict[str, Any] = {
+        "enabled": any(a.get("enabled") for a, _ in sections),
+        "calls": sum(int(a.get("calls") or 0) for a, _ in sections),
+        "audited": sum(int(a.get("audited") or 0) for a, _ in sections),
+        "shadow_errors": sum(int(a.get("shadow_errors") or 0)
+                             for a, _ in sections),
+        "mismatches": sum(int(a.get("mismatches") or 0)
+                          for a, _ in sections),
+        "fleet": True,
+    }
+    per_arm: List[Dict[str, Any]] = []
+    rows = audited_rows = 0.0
+    recs: List[Dict[str, Any]] = []
+    for a, tag in sections:
+        for e in a.get("per_arm") or []:
+            e = dict(e)
+            e["replica"] = tag
+            rows += float(e.get("rows") or 0.0)
+            audited_rows += float(e.get("audited_rows") or 0.0)
+            per_arm.append(e)
+        for m in a.get("mismatch_records") or []:
+            m = dict(m)
+            m["replica"] = tag
+            recs.append(m)
+    out["coverage"] = round(audited_rows / rows, 6) if rows > 0 else 0.0
+    out["per_arm"] = per_arm
+    out["mismatch_records"] = recs
+    # divergence: key every exported observation by what went in, then
+    # look for disagreement about what came out. Each (key, replica)
+    # keeps the full SET of observed results — a replica disagreeing
+    # with itself (nondeterminism) is divergence too, and a later
+    # same-input observation must not mask an earlier corrupt one.
+    obs: Dict[Tuple[str, str, str, int], Dict[str, List[str]]] = {}
+    for a, tag in sections:
+        for schema, ents in (a.get("digests") or {}).items():
+            for e in ents or []:
+                if not e.get("input") or not e.get("result"):
+                    continue
+                key = (str(schema), str(e.get("op")),
+                       str(e["input"]), int(e.get("chunks") or 1))
+                seen = obs.setdefault(key, {}).setdefault(tag, [])
+                if str(e["result"]) not in seen:
+                    seen.append(str(e["result"]))
+    divergent = []
+    for (schema, op, inp, chunks), by_tag in sorted(obs.items()):
+        if len({d for ds in by_tag.values() for d in ds}) > 1:
+            divergent.append({"schema": schema, "op": op,
+                              "input": inp, "chunks": chunks,
+                              "results": dict(sorted(by_tag.items()))})
+    out["divergent"] = divergent
+    return out
+
+
 def _merge_breakers(snaps: List[Dict[str, Any]],
                     tags: List[str]) -> Dict[str, Any]:
     out: Dict[str, Any] = {}
@@ -333,6 +398,16 @@ def merge_snapshots(snaps: List[Dict[str, Any]],
     brs = _merge_breakers(snaps, tags)
     if brs:
         out["breakers"] = brs
+    aud = _merge_audit(snaps, tags)
+    if aud:
+        out["audit"] = aud
+        if aud["divergent"]:
+            # the cross-replica corruption signal, as a counter so the
+            # report/prom renderers and snapshot diffs surface it
+            # metric-key: audit.fleet_divergent
+            out["counters"]["audit.fleet_divergent"] = (
+                out["counters"].get("audit.fleet_divergent", 0.0)
+                + float(len(aud["divergent"])))
     return out
 
 

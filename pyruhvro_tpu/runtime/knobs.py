@@ -224,6 +224,16 @@ _reg("PYRUHVRO_TPU_DRIFT_RATIO", "float", 1.5,
      "Fast/slow EWMA ratio that counts as latency drift.")
 _reg("PYRUHVRO_TPU_DRIFT_SUSTAIN", "int", 5,
      "Consecutive drifted observations before a detection fires.")
+_reg("PYRUHVRO_TPU_AUDIT_BUDGET", "float", 0.005,
+     "Differential-audit overhead budget as a wall-time fraction: "
+     "every ~Nth call is shadow re-executed through the pure-Python "
+     "oracle and digest-compared (<= 0 disables the audit plane).")
+_reg("PYRUHVRO_TPU_AUDIT_TIERS", "str", "",
+     "Comma list of tiers the audit plane shadows (e.g. "
+     "'native,device'); empty audits every tier.")
+_reg("PYRUHVRO_TPU_NO_AUDIT", "bool", False,
+     "Kill switch for the differential-audit plane (overrides the "
+     "budget).")
 _reg("PYRUHVRO_TPU_CAPACITY_PERSIST", "bool", False,
      "Persist learned device-capacity plans into ROUTING_PROFILE even "
      "without autotune.")

@@ -130,6 +130,11 @@ def health() -> Tuple[int, Dict[str, Any]]:
         # (the pressure evictor fires on the same signal — unhealthy
         # means "pressure happened recently", not "still over")
         "mem_pressure": recent("mem_pressure"),
+        # a differential-audit shadow caught a tier producing wrong
+        # bytes within the window (ISSUE 18) — the one bit that means
+        # "answers may be silently wrong", which outranks every
+        # latency condition above
+        "audit_mismatch": recent("audit_mismatch"),
     }
     # non-closed circuit breakers are degradation facts: the process
     # still answers (the degraded path serves), so they stay 200, but a
@@ -242,6 +247,19 @@ class _Handler(BaseHTTPRequestHandler):
                     from . import telemetry
 
                     self._send_json(200, telemetry.flight_dump())
+            elif path == "/audit":
+                if snap_doc is not None:
+                    aud = snap_doc.get("audit")
+                    self._send_json(
+                        200, aud if aud is not None else {
+                            "static": True,
+                            "note": "snapshot predates the "
+                                    "differential-audit plane",
+                        })
+                else:
+                    from . import audit
+
+                    self._send_json(200, audit.snapshot_audit())
             elif path == "/memory":
                 if snap_doc is not None:
                     mem = snap_doc.get("memory")
@@ -259,7 +277,7 @@ class _Handler(BaseHTTPRequestHandler):
                 self._send_json(404, {
                     "error": f"unknown path {path!r}",
                     "endpoints": ["/metrics", "/healthz", "/snapshot",
-                                  "/flight", "/memory"],
+                                  "/flight", "/memory", "/audit"],
                 })
         except BrokenPipeError:
             pass  # scraper went away mid-response
@@ -291,6 +309,7 @@ def _static_health(snap: Dict[str, Any]) -> Tuple[int, Dict[str, Any]]:
             "recompile_storms": counters.get("device.recompile_storm", 0),
             "drift_detections": counters.get("drift.detected", 0),
             "slo_breaches": counters.get("slo.breach", 0),
+            "audit_mismatches": counters.get("audit.mismatches", 0),
         },
     }
     if breached:
@@ -393,6 +412,6 @@ def start_from_env() -> Optional[ObsServer]:
     import sys
 
     print(f"[pyruhvro_tpu] obs server listening on {srv.url} "
-          "(/metrics /healthz /snapshot /flight /memory)",
+          "(/metrics /healthz /snapshot /flight /memory /audit)",
           file=sys.stderr)
     return srv

@@ -377,6 +377,14 @@ class call_scope:
         if self._nested or getattr(_tls, "deep", None) is None:
             return False
         dt = time.perf_counter() - self._t0
+        # an audited call's shadow re-execution (ISSUE 18) ran inside
+        # this scope: its wall seconds are the audit plane's tax, not
+        # the call's cost — keep them out of the per-feature EWMAs
+        # (non-destructive peek; the root span consumes the TLS for
+        # the SLO feed after this scope exits)
+        from . import audit
+
+        dt = max(1e-9, dt - audit.tls_shadow_seconds())
         sampled = self.sampled
         deep_ran = bool(getattr(_tls, "deep_ran", False))
         arm = getattr(_tls, "arm", None)

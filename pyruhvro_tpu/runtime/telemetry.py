@@ -354,11 +354,17 @@ class root_span:
             # objective (~one dict lookup when no SLO file is set).
             # Deep-sampled calls feed their COMPARABLE cost — the
             # sampler's own profiling tax must not trip breaches
+            from . import audit as _audit
             from . import sampling as _sampling
 
+            # an audit shadow (ISSUE 18) ran inside this root: its
+            # wall seconds are the audit plane's, not the caller's —
+            # subtract them (destructive consume) before the sampler
+            # correction so neither tax trips a latency objective
             slo.record_root(
                 s.name, s.attrs.get("schema"),
-                _sampling.consume_last_correction(s.dur_s),
+                _sampling.consume_last_correction(
+                    max(0.0, s.dur_s - _audit.consume_shadow_seconds())),
                 exc_type is not None)
             if exc_type is not None:
                 # a failed decode/encode leaves a replayable artifact
@@ -891,12 +897,13 @@ def reset() -> None:
         _roots_seen = 0
         _flight_last_auto = 0.0  # re-arm the auto-dump rate limiter
     _flight_dropped.reset()
-    from . import device_obs, drift, memacct, router, sampling
+    from . import audit, device_obs, drift, memacct, router, sampling
 
     device_obs.reset()
     router.reset()
     sampling.reset()
     drift.reset()
+    audit.reset()
     slo.reset()
     memacct.reset()
     # NOT breaker/faults: breaker state is OPERATIONAL (an open breaker
@@ -969,6 +976,11 @@ def snapshot() -> Dict[str, Any]:
     dr = drift.snapshot_drift()
     if dr:
         out["drift"] = dr
+    from . import audit
+
+    aud = audit.snapshot_audit()
+    if aud:
+        out["audit"] = aud
     from . import breaker
 
     brs = breaker.snapshot_breakers()
@@ -1478,6 +1490,14 @@ def render_report(data: Dict[str, Any]) -> str:
                     f"band={e.get('band', '?')} {e.get('arm')}: "
                     f"{e.get('detections')} detection(s), "
                     f"fast/slow={e.get('ratio')}")
+        aud = data.get("audit") or {}
+        if aud:
+            out += ["", "== differential audit =="]
+            out.append(
+                f"audited {aud.get('audited', 0)}/{aud.get('calls', 0)}"
+                f" call(s), {aud.get('mismatches', 0)} mismatch(es), "
+                f"coverage {(aud.get('coverage') or 0) * 100:.3f}% — "
+                "render with the audit-report subcommand")
         other = {k: v for k, v in counters.items()
                  if not k.endswith("_s")
                  and not k.startswith(("route.", "router."))
@@ -1547,6 +1567,11 @@ def main(argv: Optional[List[str]] = None) -> int:
         "slo-report", help="SLO objectives, burn rates and breach "
                            "state from a snapshot JSON")
     p_slo.add_argument("path")
+    p_audit = sub.add_parser(
+        "audit-report", help="differential-audit coverage, mismatch "
+                             "records and exported result digests "
+                             "from a snapshot JSON")
+    p_audit.add_argument("path")
     p_mem = sub.add_parser(
         "mem-report", help="memory accounting: RSS vs tracked cache "
                            "footprints, eviction causes and per-tenant "
@@ -1727,6 +1752,15 @@ def main(argv: Optional[List[str]] = None) -> int:
                 "not a telemetry snapshot (expected 'slo'/'counters'/"
                 "'histograms' keys)")
         sys.stdout.write(slo.render_slo_report(data))
+    elif args.cmd == "audit-report":
+        if not ({"audit", "counters", "histograms"} & set(data)):
+            return _usage_error(
+                "not a telemetry snapshot (expected 'audit'/'counters'/"
+                "'histograms' keys)")
+        from . import audit as _audit
+
+        sys.stdout.write(_audit.render_audit_report(data))
+        sys.stdout.write("\n")
     elif args.cmd == "mem-report":
         if not ({"memory", "counters", "histograms"} & set(data)):
             return _usage_error(

@@ -1,0 +1,148 @@
+#!/usr/bin/env python
+"""Audit soak: the differential-audit plane (ISSUE 18) under sustained
+production-shaped traffic, run as a standalone gate for the slow CI
+perf-artifacts job.
+
+Decodes 100k kafka-style rows through the routed API in many calls at
+a 5% audit budget, and additionally arms the plane's force-next latch
+at a fixed cadence so dozens of calls shadow through the pure-Python
+oracle regardless of the measured cost ratio (at 5% the natural period
+on this workload spaces audits wider than a 200-call run — the pacing
+math itself is covered by bench.py and the unit tests; the soak's job
+is volume on the COMPARISON path). Asserts the steady-state contract:
+
+  * **zero mismatches** — every audited call's per-column digests agree
+    between the serving tier and the independent oracle re-execution
+    (a mismatch here is a real cross-tier correctness bug, not flake:
+    the digests are slice/chunk/layout-invariant by construction);
+  * **real coverage** — audits actually fired (audited > 0) and the
+    age-decayed coverage gauge is positive;
+  * **bounded caller cost** — the plane's own accounting keeps the
+    amortized shadow fraction (cost_ratio / period) within the
+    configured budget;
+  * **clean error ledger** — no shadow errors (nothing chaotic is
+    injected here; a shadow crash under clean traffic is a bug).
+
+Writes ``AUDIT_REPORT.json`` (atomic) with the final audit section,
+the rendered audit-report text and the pass/fail verdict per
+invariant, so CI uploads an inspectable artifact.
+
+Usage::
+
+    JAX_PLATFORMS=cpu python scripts/audit_soak.py [--rows 100000]
+        [--rows-per-call 500] [--budget 0.05] [--out AUDIT_REPORT.json]
+
+Exit 1 on any invariant violation.
+"""
+
+from __future__ import annotations
+
+import argparse
+import faulthandler
+import os
+import sys
+import time
+
+sys.path.append(".")
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+WATCHDOG_S = 600
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--rows", type=int, default=100_000)
+    ap.add_argument("--rows-per-call", type=int, default=500)
+    ap.add_argument("--budget", type=float, default=0.05)
+    ap.add_argument("--out", default="AUDIT_REPORT.json")
+    args = ap.parse_args()
+
+    faulthandler.dump_traceback_later(WATCHDOG_S, exit=True)
+    os.environ["PYRUHVRO_TPU_AUDIT_BUDGET"] = str(args.budget)
+
+    import pyruhvro_tpu as p
+    from pyruhvro_tpu.runtime import audit, metrics, telemetry
+    from pyruhvro_tpu.utils.datagen import (
+        KAFKA_SCHEMA_JSON,
+        kafka_style_datums,
+    )
+
+    calls = max(1, args.rows // args.rows_per_call)
+    print(f"[audit-soak] {calls} calls x {args.rows_per_call} rows "
+          f"at budget {args.budget}", flush=True)
+
+    # a few distinct corpora so schema/dictionary caches behave like
+    # production, and both decode and encode lanes see audits
+    corpora = [kafka_style_datums(args.rows_per_call, seed=s)
+               for s in range(8)]
+    batches = [p.deserialize_array(c, KAFKA_SCHEMA_JSON,
+                                   backend="host") for c in corpora]
+    t0 = time.perf_counter()
+    rows = 0
+    for i in range(calls):
+        if i % 4 == 0:
+            audit.force_next()  # fixed-cadence shadow volume (the
+            # latch is consumed by the NEXT eligible call, so this
+            # lands on every call shape in the mix below over time)
+        if i % 5 == 4:
+            p.serialize_record_batch(batches[i % len(batches)],
+                                     KAFKA_SCHEMA_JSON, 2,
+                                     backend="host")
+        elif i % 3 == 2:
+            p.deserialize_array_threaded(corpora[i % len(corpora)],
+                                         KAFKA_SCHEMA_JSON, 2,
+                                         backend="host")
+        else:
+            p.deserialize_array(corpora[i % len(corpora)],
+                                KAFKA_SCHEMA_JSON, backend="host")
+        rows += args.rows_per_call
+    wall_s = time.perf_counter() - t0
+
+    snap = telemetry.snapshot()
+    aud = snap.get("audit") or {}
+    counters = metrics.snapshot()
+    period = max(1, int(aud.get("period") or 1))
+    amortized = float(aud.get("cost_ratio") or 0.0) / period
+
+    checks = {
+        "zero_mismatches": int(aud.get("mismatches") or 0) == 0,
+        "audits_fired": int(aud.get("audited") or 0) > 0,
+        "coverage_positive": float(aud.get("coverage") or 0.0) > 0.0,
+        "no_shadow_errors": int(aud.get("shadow_errors") or 0) == 0,
+        "amortized_within_budget": amortized <= args.budget + 0.005,
+    }
+    ok = all(checks.values())
+
+    report = {
+        "rows": rows,
+        "calls": calls,
+        "budget": args.budget,
+        "wall_s": round(wall_s, 3),
+        "amortized_shadow_frac": round(amortized, 6),
+        "checks": checks,
+        "ok": ok,
+        "audit": aud,
+        "mismatch_counters": {k: v for k, v in counters.items()
+                              if k.startswith("audit.mismatch")},
+        "rendered": audit.render_audit_report(snap),
+    }
+    from pyruhvro_tpu.runtime import fsio
+
+    fsio.atomic_write_json(args.out, report, indent=2)
+
+    print(report["rendered"], flush=True)
+    for name, passed in checks.items():
+        print(f"[audit-soak] {'PASS' if passed else 'FAIL'} {name}",
+              flush=True)
+    print(f"[audit-soak] {'OK' if ok else 'FAILED'}: {rows} rows, "
+          f"{aud.get('audited')} audited, "
+          f"{aud.get('mismatches')} mismatches, "
+          f"coverage {aud.get('coverage')}, wall {wall_s:.1f}s "
+          f"-> {args.out}", flush=True)
+    faulthandler.cancel_dump_traceback_later()
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,500 @@
+"""Differential-audit plane (ISSUE 18): canonical per-column digests,
+sampled shadow re-execution, mismatch incidents, coverage accounting,
+the audit-report CLI / ``/audit`` endpoint, and the fleet divergence
+merge.
+
+The digest is the load-bearing piece: it must be a pure function of
+LOGICAL column content — invariant under slicing, chunk layout and
+union-lane garbage — or the audit plane would page on phantom
+mismatches. The parity tests pin that across every execution tier the
+router can pick.
+"""
+
+import json
+import os
+import urllib.error
+import urllib.request
+
+import pyarrow as pa
+import pytest
+
+import pyruhvro_tpu as p
+from pyruhvro_tpu import api
+from pyruhvro_tpu.fallback.decoder import decode_to_record_batch
+from pyruhvro_tpu.gate import device_supported
+from pyruhvro_tpu.hostpath import NativeHostCodec, native_available
+from pyruhvro_tpu.runtime import (
+    audit,
+    coldigest,
+    costmodel,
+    fleet,
+    metrics,
+    obs_server,
+    telemetry,
+)
+from pyruhvro_tpu.schema.cache import get_or_parse_schema
+from pyruhvro_tpu.utils.datagen import (
+    KAFKA_SCHEMA_JSON,
+    kafka_style_datums,
+    random_datums,
+    random_schema,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+LEGACY_SNAPSHOT = os.path.join(
+    REPO, "tests", "data", "telemetry_snapshot_sample.json")
+
+
+@pytest.fixture
+def audit_on(monkeypatch):
+    """Audit enabled with a saturating budget (period still applies —
+    tests arm specific calls with force_next)."""
+    monkeypatch.setenv("PYRUHVRO_TPU_AUDIT_BUDGET", "1.0")
+    yield
+
+
+def _get(url):
+    try:
+        with urllib.request.urlopen(url, timeout=10) as r:
+            return r.status, r.read()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read()
+
+
+# ---------------------------------------------------------------------------
+# digest parity across tiers (the audit plane's no-false-positive
+# contract)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("seed", range(100))
+def test_digest_parity_across_tiers(seed):
+    """One random schema, one datum corpus, every host-side execution
+    path (pure-Python oracle, native VM, routed single-call API,
+    shard-runner chunked API): identical per-column digests. Plus
+    slice/chunk invariance of the digest itself."""
+    schema = random_schema(seed)
+    entry = get_or_parse_schema(schema)
+    datums = random_datums(entry.ir, 24, seed=seed + 5000)
+
+    oracle = decode_to_record_batch(datums, entry.ir, entry.arrow_schema)
+    want = coldigest.column_digests(oracle)
+
+    if native_available():
+        codec = NativeHostCodec(entry.ir, entry.arrow_schema)
+        assert coldigest.column_digests(codec.decode(datums)) == want, schema
+
+    routed = p.deserialize_array(datums, schema, backend="host")
+    assert coldigest.column_digests(routed) == want, schema
+
+    chunked = p.deserialize_array_threaded(datums, schema, 3,
+                                           backend="host")
+    assert coldigest.column_digests(chunked) == want, schema
+
+    # slicing/chunk-layout invariance: same logical rows, any layout
+    k = oracle.num_rows // 2
+    sliced = [oracle.slice(0, k), oracle.slice(k)]
+    assert coldigest.column_digests(sliced) == want, schema
+
+
+def test_digest_parity_device_tier():
+    """The device tier decodes through a completely different engine
+    (JAX gather kernels); its results must digest identically to the
+    oracle's. A handful of schemas — device compiles are the expensive
+    part, and the kernel path is shared."""
+    checked = 0
+    for seed in range(40):
+        schema = random_schema(seed)
+        entry = get_or_parse_schema(schema)
+        if not device_supported(entry.ir):
+            continue
+        datums = random_datums(entry.ir, 32, seed=seed + 9000)
+        oracle = decode_to_record_batch(
+            datums, entry.ir, entry.arrow_schema)
+        got = p.deserialize_array(datums, schema, backend="tpu")
+        assert (coldigest.column_digests(got)
+                == coldigest.column_digests(oracle)), schema
+        checked += 1
+        if checked >= 3:
+            break
+    assert checked, "no device-supported schema in the sample"
+
+
+def test_digest_sliced_sparse_union_normalized():
+    """A sliced sparse union hashes equal to its compacted rebuild:
+    lane garbage outside the selected type-ids must not leak into the
+    digest (this is exactly the layout `compact_union_slices`
+    normalizes on the encode path)."""
+    from pyruhvro_tpu.ops.arrow_build import compact_union_slices
+
+    batch = p.deserialize_array(kafka_style_datums(60, seed=11),
+                                KAFKA_SCHEMA_JSON, backend="host")
+    u = batch.column(batch.schema.names.index("status"))
+    for lo, n in ((0, 30), (13, 29), (31, 29)):
+        s = batch.slice(lo, n)
+        compacted = compact_union_slices(s).column(
+            batch.schema.names.index("status"))
+        assert (coldigest.array_digest(u.slice(lo, n))
+                == coldigest.array_digest(compacted))
+    # and differing content still differs
+    assert (coldigest.array_digest(u.slice(0, 30))
+            != coldigest.array_digest(u.slice(30, 30)))
+
+
+@pytest.mark.parametrize("policy", ["skip", "null"])
+def test_tolerant_results_audit_clean(policy, audit_on):
+    """Tolerant decodes (dropped or nulled quarantined rows) audit
+    clean: the shadow replays the same policy and the digests agree —
+    no phantom mismatch from the error-handling path itself."""
+    entry = get_or_parse_schema(KAFKA_SCHEMA_JSON)
+    datums = kafka_style_datums(40, seed=5)
+    datums[7] = b"\xff"  # never a valid kafka record
+    audit.force_next()
+    p.deserialize_array(datums, KAFKA_SCHEMA_JSON, backend="host",
+                        on_error=policy)
+    snap = metrics.snapshot()
+    assert snap.get("audit.audited") == 1.0
+    assert not snap.get("audit.mismatches")
+    assert not snap.get("audit.shadow_error")
+    # the shadow helper alone also matches the routed result
+    got = p.deserialize_array(datums, KAFKA_SCHEMA_JSON,
+                              backend="host", on_error=policy)
+    shadow = api._audit_shadow_decode(
+        entry, datums, [(0, len(datums))], policy)
+    assert (coldigest.column_digests(got)
+            == coldigest.column_digests(shadow))
+
+
+def test_encode_roundtrip_audit_clean(audit_on):
+    batch = p.deserialize_array(kafka_style_datums(50, seed=2),
+                                KAFKA_SCHEMA_JSON, backend="host")
+    audit.force_next()
+    p.serialize_record_batch(batch, KAFKA_SCHEMA_JSON, 2,
+                             backend="host")
+    snap = metrics.snapshot()
+    assert snap.get("audit.audited") == 1.0
+    assert not snap.get("audit.mismatches")
+    assert not snap.get("audit.shadow_error")
+
+
+# ---------------------------------------------------------------------------
+# planted corruption: the detection path end-to-end
+# ---------------------------------------------------------------------------
+
+
+def _flip_buffer_byte(batch, name, row):
+    """Bit-flip one byte of one row in a fixed-width column's data
+    buffer — the smallest possible silent corruption."""
+    idx = batch.schema.names.index(name)
+    arr = batch.column(idx)
+    assert arr.offset == 0
+    width = arr.type.bit_width // 8
+    bufs = arr.buffers()
+    data = bytearray(bufs[1].to_pybytes())
+    data[row * width] ^= 0x01
+    cols = list(batch.columns)
+    cols[idx] = pa.Array.from_buffers(
+        arr.type, len(arr), [bufs[0], pa.py_buffer(bytes(data))])
+    return pa.RecordBatch.from_arrays(cols, schema=batch.schema)
+
+
+def test_planted_corruption_detected_end_to_end(audit_on, monkeypatch):
+    """The acceptance scenario: a single flipped buffer byte in the
+    primary result → mismatch counter fires on the right column, the
+    structured record bisects to the exact row, healthz goes unhealthy,
+    and the router withholds the lying arm."""
+    datums = kafka_style_datums(50, seed=3)
+    real = api._maybe_audit_decode
+
+    def corrupting(dec, entry, data, bounds, on_error, result):
+        real(dec, entry, data, bounds, on_error,
+             _flip_buffer_byte(result, "created_at", 17))
+
+    monkeypatch.setattr(api, "_maybe_audit_decode", corrupting)
+    audit.force_next()
+    batch = p.deserialize_array(datums, KAFKA_SCHEMA_JSON,
+                                backend="host")
+    assert batch.num_rows == 50  # the caller's result is untouched
+
+    snap = metrics.snapshot()
+    assert snap.get("audit.mismatches") == 1.0
+    assert snap.get("audit.mismatch.created_at") == 1.0
+    [m] = audit.mismatches()
+    assert m["column"] == "created_at"
+    assert m["row_index"] == 17
+    assert m["op"] == "decode"
+    assert m["primary_digest"] != m["shadow_digest"]
+    assert m["trace_id"]
+
+    # the router now refuses the arm that produced the wrong bytes
+    assert costmodel.arm_penalized(m["schema"], m["arm"])
+    assert snap.get("router.arm_penalty") == 1.0
+
+    # quarantine carried the evidence record
+    assert snap.get("audit.quarantined") == 1.0
+
+    # healthz flips: a process serving wrong answers is not healthy
+    server = obs_server.ObsServer(port=0).start()
+    try:
+        status, body = _get(f"http://127.0.0.1:{server.port}/healthz")
+        assert status == 503
+        doc = json.loads(body)
+        assert doc["unhealthy_bits"]["audit_mismatch"] is True
+        status, body = _get(f"http://127.0.0.1:{server.port}/audit")
+        assert status == 200
+        doc = json.loads(body)
+        assert doc["mismatches"] == 1
+        assert doc["mismatch_records"][0]["row_index"] == 17
+    finally:
+        server.stop()
+
+    # snapshot carries the full section
+    aud = telemetry.snapshot()["audit"]
+    assert aud["mismatches"] == 1
+    assert aud["mismatch_records"][0]["column"] == "created_at"
+
+
+def test_row_count_mismatch_is_its_own_column(audit_on, monkeypatch):
+    datums = kafka_style_datums(20, seed=9)
+    real = api._maybe_audit_decode
+
+    def truncating(dec, entry, data, bounds, on_error, result):
+        real(dec, entry, data, bounds, on_error, result.slice(0, 15))
+
+    monkeypatch.setattr(api, "_maybe_audit_decode", truncating)
+    audit.force_next()
+    p.deserialize_array(datums, KAFKA_SCHEMA_JSON, backend="host")
+    [m] = audit.mismatches()
+    assert m["column"] == "#rows"
+    assert (m["primary_digest"], m["shadow_digest"]) == ("15", "20")
+
+
+# ---------------------------------------------------------------------------
+# sampling, budget and coverage accounting
+# ---------------------------------------------------------------------------
+
+
+def test_budget_zero_is_a_noop(monkeypatch):
+    monkeypatch.setenv("PYRUHVRO_TPU_AUDIT_BUDGET", "0")
+    assert not audit.enabled()
+    datums = kafka_style_datums(30, seed=1)
+    audit.force_next()  # even an armed latch must not fire when off
+    batch = p.deserialize_array(datums, KAFKA_SCHEMA_JSON,
+                                backend="host")
+    assert not [k for k in metrics.snapshot() if k.startswith("audit.")]
+    assert audit.snapshot_audit() == {}
+    assert "audit" not in telemetry.snapshot()
+    # and the result is byte-identical to an audited run's
+    monkeypatch.setenv("PYRUHVRO_TPU_AUDIT_BUDGET", "1.0")
+    audit.force_next()
+    audited = p.deserialize_array(datums, KAFKA_SCHEMA_JSON,
+                                  backend="host")
+    assert batch.equals(audited)
+
+
+def test_no_audit_kill_switch(monkeypatch, audit_on):
+    monkeypatch.setenv("PYRUHVRO_TPU_NO_AUDIT", "1")
+    assert not audit.enabled()
+    audit.force_next()
+    p.deserialize_array(kafka_style_datums(10, seed=4),
+                        KAFKA_SCHEMA_JSON, backend="host")
+    assert not metrics.snapshot().get("audit.audited")
+
+
+def test_tier_filter(monkeypatch, audit_on):
+    monkeypatch.setenv("PYRUHVRO_TPU_AUDIT_TIERS", "device")
+    audit.force_next()
+    p.deserialize_array(kafka_style_datums(10, seed=4),
+                        KAFKA_SCHEMA_JSON, backend="host")
+    assert not metrics.snapshot().get("audit.audited")
+    monkeypatch.setenv("PYRUHVRO_TPU_AUDIT_TIERS", "native,fallback")
+    audit.force_next()
+    p.deserialize_array(kafka_style_datums(10, seed=4),
+                        KAFKA_SCHEMA_JSON, backend="host")
+    assert metrics.snapshot().get("audit.audited") == 1.0
+
+
+def test_shadow_work_never_reads_as_traffic(monkeypatch):
+    """The double-count fix: an audited call must leave exactly the
+    same non-audit counters behind as the identical unaudited call —
+    the shadow's deltas are recorded and undone, its wall seconds
+    subtracted from the sampler/SLO feeds."""
+    datums = kafka_style_datums(40, seed=6)
+
+    def run(budget):
+        telemetry.reset()
+        monkeypatch.setenv("PYRUHVRO_TPU_AUDIT_BUDGET", budget)
+        if float(budget) > 0:
+            audit.force_next()
+        p.deserialize_array(datums, KAFKA_SCHEMA_JSON, backend="host")
+        return {k: v for k, v in metrics.snapshot().items()
+                if not k.startswith("audit.")}
+
+    base, audited = run("0"), run("1.0")
+    telemetry.reset()
+    # wall-time accumulators (*_s) legitimately differ run to run;
+    # everything countable must match exactly, and no new nonzero key
+    # may appear (an undone delta leaves at most a 0.0 residue)
+    assert ({k for k, v in audited.items() if v}
+            == {k for k, v in base.items() if v})
+    assert ({k: v for k, v in audited.items() if not k.endswith("_s")}
+            == {k: v for k, v in base.items() if not k.endswith("_s")})
+    # the root span consumed the shadow seconds (SLO feed correction)
+    assert audit.tls_shadow_seconds() == 0.0
+
+
+def test_coverage_gauge_math(audit_on):
+    datums = kafka_style_datums(30, seed=8)
+    audit.force_next()
+    p.deserialize_array(datums, KAFKA_SCHEMA_JSON, backend="host")
+    p.deserialize_array(datums, KAFKA_SCHEMA_JSON, backend="host")
+    aud = telemetry.snapshot()["audit"]
+    assert aud["calls"] == 2
+    assert aud["audited"] == 1
+    # equal row counts, one of two calls audited -> coverage 1/2
+    assert aud["coverage"] == pytest.approx(0.5, abs=1e-6)
+    [arm] = aud["per_arm"]
+    assert arm["audited_rows"] == pytest.approx(30.0, abs=1e-3)
+    assert arm["rows"] == pytest.approx(60.0, abs=1e-3)
+    assert metrics.gauges()["audit.coverage"] == pytest.approx(
+        aud["coverage"], abs=1e-6)
+
+
+def test_coverage_age_decays(audit_on):
+    datums = kafka_style_datums(20, seed=8)
+    audit.force_next()
+    p.deserialize_array(datums, KAFKA_SCHEMA_JSON, backend="host")
+    with audit._lock:
+        [st] = audit._coverage.values()
+        calls_before = st[0]
+        st[4] -= audit._COVERAGE_HALF_LIFE_S  # age by one half-life
+    aud = audit.snapshot_audit()
+    [arm] = aud["per_arm"]
+    assert arm["calls"] == pytest.approx(calls_before / 2, rel=1e-3)
+    # decay scales rows and audited_rows equally: coverage is stable
+    assert aud["coverage"] == pytest.approx(1.0, abs=1e-6)
+
+
+def test_period_tracks_cost_ratio(audit_on):
+    """period ≈ shadow/primary cost ratio / budget — the wall-fraction
+    contract that keeps overhead at the knob's value."""
+    audit.force_next()
+    p.deserialize_array(kafka_style_datums(40, seed=2),
+                        KAFKA_SCHEMA_JSON, backend="host")
+    aud = telemetry.snapshot()["audit"]
+    assert aud["period"] == max(1, round(aud["cost_ratio"]
+                                         / aud["budget"]))
+
+
+def test_encode_skip_reason_quarantine(audit_on):
+    """A tolerant encode that quarantined rows is structurally
+    incomparable (survivor re-chunking breaks row alignment): counted
+    as skipped, never audited, never a phantom mismatch."""
+    from decimal import Decimal
+
+    DS = ('{"type":"record","name":"D","fields":[{"name":"d","type":'
+          '{"type":"fixed","name":"Fx","size":1,"logicalType":"decimal",'
+          '"precision":3,"scale":0}}]}')
+    arr = pa.array([Decimal(1), Decimal(500), Decimal(7)],
+                   type=pa.decimal128(3, 0))
+    batch = pa.RecordBatch.from_arrays([arr], names=["d"])
+    audit.force_next()
+    p.serialize_record_batch(batch, DS, 1, backend="host",
+                             on_error="skip")
+    snap = metrics.snapshot()
+    assert snap.get("audit.skipped_quarantine") == 1.0
+    assert not snap.get("audit.audited")
+    assert not snap.get("audit.mismatches")
+
+
+# ---------------------------------------------------------------------------
+# CLI, endpoint, snapshot contract
+# ---------------------------------------------------------------------------
+
+
+def _audited_snapshot(tmp_path):
+    os.environ["PYRUHVRO_TPU_AUDIT_BUDGET"] = "1.0"
+    try:
+        audit.force_next()
+        p.deserialize_array(kafka_style_datums(30, seed=12),
+                            KAFKA_SCHEMA_JSON, backend="host")
+        snap = telemetry.snapshot()
+    finally:
+        del os.environ["PYRUHVRO_TPU_AUDIT_BUDGET"]
+    path = tmp_path / "snap.json"
+    path.write_text(json.dumps(snap, default=str))
+    return str(path), snap
+
+
+def test_audit_report_cli(tmp_path, capsys):
+    path, snap = _audited_snapshot(tmp_path)
+    assert telemetry.main(["audit-report", path]) == 0
+    out = capsys.readouterr().out
+    assert "== differential audit ==" in out
+    assert "audited 1" in out
+    assert "no mismatches observed" in out
+    # the main report carries the one-paragraph brief
+    assert telemetry.main(["report", path]) == 0
+    out = capsys.readouterr().out
+    assert "== differential audit ==" in out
+
+
+def test_audit_report_degrades_on_legacy_snapshot(capsys):
+    assert telemetry.main(["audit-report", LEGACY_SNAPSHOT]) == 0
+    assert "no audit section" in capsys.readouterr().out
+
+
+def test_audit_report_exit2_contract(tmp_path, capsys):
+    assert telemetry.main(
+        ["audit-report", str(tmp_path / "nope.json")]) == 2
+    bad = tmp_path / "bad.json"
+    bad.write_text("{not json")
+    assert telemetry.main(["audit-report", str(bad)]) == 2
+    notsnap = tmp_path / "notsnap.json"
+    notsnap.write_text('{"foo": 1}')
+    assert telemetry.main(["audit-report", str(notsnap)]) == 2
+    capsys.readouterr()
+
+
+def test_audit_endpoint_static_modes(tmp_path):
+    _, snap = _audited_snapshot(tmp_path)
+    server = obs_server.ObsServer(port=0, snapshot=snap).start()
+    try:
+        status, body = _get(f"http://127.0.0.1:{server.port}/audit")
+        assert status == 200
+        assert json.loads(body)["audited"] == 1
+    finally:
+        server.stop()
+    legacy = json.load(open(LEGACY_SNAPSHOT))
+    server = obs_server.ObsServer(port=0, snapshot=legacy).start()
+    try:
+        status, body = _get(f"http://127.0.0.1:{server.port}/audit")
+        assert status == 200
+        assert b"predates" in body or json.loads(body) == {}
+    finally:
+        server.stop()
+
+
+# ---------------------------------------------------------------------------
+# fleet divergence
+# ---------------------------------------------------------------------------
+
+
+def test_fleet_merge_flags_cross_replica_divergence(tmp_path):
+    _, s1 = _audited_snapshot(tmp_path)
+    s2 = json.loads(json.dumps(s1, default=str))
+    clean = fleet.merge_snapshots(
+        [s1, json.loads(json.dumps(s1, default=str))], ["a", "b"])
+    assert clean["audit"]["divergent"] == []
+    assert "audit.fleet_divergent" not in clean["counters"]
+    assert clean["audit"]["audited"] == 2
+    # tamper replica b's exported result digest for one input
+    ent = next(iter(s2["audit"]["digests"].values()))[0]
+    ent["result"] = "0" * 32
+    merged = fleet.merge_snapshots([s1, s2], ["a", "b"])
+    [d] = merged["audit"]["divergent"]
+    assert set(d["results"]) == {"a", "b"}
+    assert d["results"]["a"] != d["results"]["b"]
+    assert merged["counters"]["audit.fleet_divergent"] == 1.0
+    # the merged doc still renders through the standard report
+    assert "== differential audit ==" in telemetry.render_report(merged)

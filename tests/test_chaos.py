@@ -553,6 +553,52 @@ def test_slo_alert_fault_counts_error_and_call_survives(chaos):
     assert c.get("slo.alert_fired") is None, c
 
 
+def test_audit_shadow_fault_degrades_to_counted_error(
+        chaos, monkeypatch):
+    """A crashing differential-audit shadow (ISSUE 18) is the audit
+    plane's own degradation seam: the caller's already-computed result
+    is served untouched and the failure is a counted
+    ``audit.shadow_error`` — never an exception, never a mismatch."""
+    from pyruhvro_tpu.runtime import audit
+
+    monkeypatch.setenv("PYRUHVRO_TPU_AUDIT_BUDGET", "1.0")
+    chaos("audit_shadow:error:1")
+    audit.force_next()
+    datums = kafka_style_datums(30, seed=21)
+    batch = p.deserialize_array(datums, KAFKA_SCHEMA_JSON,
+                                backend="host")
+    assert batch.num_rows == 30
+    c = metrics.snapshot()
+    assert c.get("fault.injected.audit_shadow") == 1.0, c
+    assert c.get("audit.shadow_error") == 1.0, c
+    assert c.get("audit.audited") is None, c
+    assert c.get("audit.mismatches") is None, c
+
+
+def test_audit_shadow_hang_bounded_by_call_deadline(
+        chaos, monkeypatch):
+    """A hanging shadow is bounded by the CALLER's deadline: the
+    shadow's own ``deadline.check`` trips after the hang, the expiry is
+    swallowed as a shadow error, and the call still returns its result
+    (late, but bounded — not wedged)."""
+    from pyruhvro_tpu.runtime import audit
+
+    monkeypatch.setenv("PYRUHVRO_TPU_AUDIT_BUDGET", "1.0")
+    chaos("audit_shadow:hang:1", hang_s=0.6)
+    audit.force_next()
+    datums = kafka_style_datums(30, seed=22)
+    t0 = time.perf_counter()
+    batch = p.deserialize_array(datums, KAFKA_SCHEMA_JSON,
+                                backend="host", timeout_s=0.25)
+    dt = time.perf_counter() - t0
+    assert batch.num_rows == 30  # no DeadlineExceeded reached the caller
+    assert 0.5 < dt < 5.0  # hung for the injected sleep, then bounded
+    c = metrics.snapshot()
+    assert c.get("fault.injected.audit_shadow") == 1.0, c
+    assert c.get("audit.shadow_error") == 1.0, c
+    assert c.get("audit.audited") is None, c
+
+
 # ---------------------------------------------------------------------------
 # deadlines: the per-call budget layer
 # ---------------------------------------------------------------------------
