@@ -60,10 +60,11 @@ from .lints import iter_py_files
 
 __all__ = ["analyze", "run_concurrency"]
 
-# the package subtree whose module-level mutable state must declare a
+# the package subtrees whose module-level mutable state must declare a
 # guard: the runtime plane is the one imported by every tier and hit
-# from API threads, pool workers, the obs server thread and atexit
-_GUARD_SCOPE = "pyruhvro_tpu/runtime"
+# from API threads, pool workers, the obs server thread and atexit; the
+# serving plane adds its own worker threads and signal-drain thread
+_GUARD_SCOPES = ("pyruhvro_tpu/runtime", "pyruhvro_tpu/serving")
 
 _GUARDED_BY = "guarded-by:"
 _LOCK_FREE_OK = "lock-free-ok"
@@ -582,7 +583,7 @@ def _check_guarded_globals(mods: Dict[str, _Module]) -> Tuple[
     guarded_inv: List[dict] = []
     waived_inv: List[dict] = []
     for m in mods.values():
-        in_scope = _GUARD_SCOPE in m.rel
+        in_scope = any(s in m.rel for s in _GUARD_SCOPES)
         # every name assigned under a `global` declaration anywhere
         rebound: Dict[str, int] = {}
         for node in ast.walk(m.tree):

@@ -734,10 +734,7 @@ def _proc_decode_task(payload):
             # boundary (__reduce__ keeps the structured fields)
             raise shift_malformed(e, base) from None
     if errs:
-        w.payload["quarantine"] = [
-            (q.index + base, q.datum, q.error, q.tier, q.trace_id)
-            for q in errs
-        ]
+        w.payload["quarantine"] = quarantine.rebase(errs, base)
     return batch, w.payload
 
 
@@ -755,10 +752,7 @@ def _proc_encode_task(payload):
                 return_errors=True,
             )
     if errs:
-        w.payload["quarantine"] = [
-            (q.index + base, q.datum, q.error, q.tier, q.trace_id)
-            for q in errs
-        ]
+        w.payload["quarantine"] = quarantine.rebase(errs, base)
     return arr, w.payload
 
 

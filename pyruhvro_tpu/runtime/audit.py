@@ -56,6 +56,7 @@ from . import coldigest, knobs, metrics
 __all__ = [
     "AuditMismatch",
     "enabled",
+    "set_enabled",
     "budget",
     "force_next",
     "maybe_audit",
@@ -115,7 +116,24 @@ def budget() -> float:
     return knobs.get_float("PYRUHVRO_TPU_AUDIT_BUDGET")
 
 
+# lock-free-ok(single GIL-atomic store; the serving plane's brownout
+# ladder flips it from worker threads and readers tolerate staleness —
+# one extra/missing shadow either side of the flip is harmless)
+_forced: Optional[bool] = None
+
+
+def set_enabled(flag: Optional[bool]) -> None:
+    """Force the audit plane on/off in-process regardless of the env
+    knobs; ``None`` restores knob-driven behavior. The serving plane's
+    brownout ladder sheds audit shadowing through this (mirrors
+    ``sampling.set_enabled``)."""
+    global _forced
+    _forced = flag
+
+
 def enabled() -> bool:
+    if _forced is not None:
+        return _forced
     return (budget() > 0
             and not knobs.get_bool("PYRUHVRO_TPU_NO_AUDIT"))
 
@@ -481,7 +499,8 @@ def reset() -> None:
     """Clear all audit state (test isolation; cascaded from
     ``telemetry.reset()``)."""
     global _calls_since, _pending, _period, _ratio, _calls, _audited
-    global _shadow_errors
+    global _shadow_errors, _forced
+    _forced = None
     with _lock:
         _coverage.clear()
         _exports.clear()

@@ -269,6 +269,10 @@ _DEFAULTS = {
     # (collectors restart), then back off — a dead collector costs one
     # probe per backoff window instead of one timeout per interval
     "otlp_export": (2, 1.0),
+    # the serving plane's coalesced micro-batch seam: its fallback —
+    # per-request serial execution — is warm and byte-identical, so a
+    # couple of failures may probe before batching is withheld
+    "serve_worker": (2, 1.0),
 }
 
 

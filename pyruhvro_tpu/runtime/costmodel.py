@@ -113,11 +113,29 @@ def autotune_enabled() -> bool:
     return knobs.get_bool("PYRUHVRO_TPU_AUTOTUNE")
 
 
+# lock-free-ok(single GIL-atomic store; flipped by the serving plane's
+# brownout ladder from worker threads — a few explore ticks either side
+# of the flip are harmless)
+_explore_override: Optional[float] = None
+
+
+def set_explore_override(rate: Optional[float]) -> None:
+    """Force the exploration rate in-process regardless of
+    ``PYRUHVRO_TPU_EXPLORE``; ``None`` restores knob-driven behavior.
+    The serving plane's brownout ladder suppresses explore arms under
+    sustained pressure through this."""
+    global _explore_override
+    _explore_override = rate
+
+
 def explore_rate() -> float:
     """Exploration rate in [0, 1] (``PYRUHVRO_TPU_EXPLORE``, default
     0.05): roughly this fraction of autotuned calls try the
     least-observed candidate arm instead of the predicted-best one.
     0 disables exploration (pure exploitation of the warm profile)."""
+    ov = _explore_override
+    if ov is not None:
+        return min(1.0, max(0.0, ov))
     return min(1.0, max(0.0, knobs.get_float("PYRUHVRO_TPU_EXPLORE")))
 
 
@@ -209,6 +227,32 @@ def obs_count(schema: str, op: str, band: int, arm: str) -> float:
     with _lock:
         st = _stats.get((schema, op, int(band), arm))
         return st[0] if st else 0.0
+
+
+def predict_drain(schema: str, op: str, rows: int) -> Optional[float]:
+    """Predicted wall seconds to process ``rows`` of ``schema`` on the
+    BEST observed arm at any band — the serving plane's shed
+    retry-after hint ("come back once the backlog should have
+    drained"). Optimistic by construction (the router will pick at
+    least this good an arm); None when the model has never observed
+    this (schema, op)."""
+    with _lock:
+        best = None
+        for (s, o, _band, arm), st in _stats.items():
+            if s != schema or o != op or st[0] <= 0:
+                continue
+            est = st[1] * max(int(rows), 1) * _arm_factor_locked(s, arm)
+            if best is None or est < best:
+                best = est
+    return best
+
+
+def persistence_armed() -> bool:
+    """Has :func:`arm_persistence` run (profile loaded + exit-time save
+    registered)? The serving plane's drain flushes the profile only
+    when this is armed — never creating files nobody asked for."""
+    with _lock:
+        return _persist_armed
 
 
 def tick(schema: str, op: str, band: int) -> int:
@@ -615,6 +659,8 @@ def reset() -> None:
     """Clear the in-memory model, schedules and penalties (test
     isolation; called from ``telemetry.reset()``). Does not touch the
     on-disk profile."""
+    global _explore_override
+    _explore_override = None
     with _lock:
         _stats.clear()
         _loaded.clear()

@@ -87,6 +87,11 @@ SITES = (
     "obs_handler",      # obs_server request handler
     "slo_alert",        # slo alert_command hook
     "audit_shadow",     # audit: shadow re-execution through the oracle
+    "serve_enqueue",    # serving: admission seam (degrades to a direct
+                        # synchronous call, bypassing the queue)
+    "serve_worker",     # serving: coalesced micro-batch execution seam
+                        # (degrades to the per-request serial path)
+    "serve_flight",     # serving/flight.py: Arrow Flight handler seam
 )
 
 _KINDS = ("error", "hang", "exit")

@@ -276,6 +276,41 @@ _reg("PYRUHVRO_TPU_SCHED_POINTS", "str", "",
      "Comma list restricting which named schedtest yield-points "
      "participate in a harness run (empty = all).")
 
+# ---- serving plane --------------------------------------------------------
+_reg("PYRUHVRO_TPU_SERVE_QUEUE", "int", 256,
+     "Per-(schema, tenant) bounded serving-queue depth in requests; "
+     "a full queue triggers the backpressure policy.")
+_reg("PYRUHVRO_TPU_SERVE_POLICY", "enum", "block",
+     "Backpressure policy on a full serving queue: 'block' waits up "
+     "to the enqueue deadline for space, 'shed' rejects immediately "
+     "with a structured Overloaded carrying a retry-after hint.",
+     choices=("block", "shed"))
+_reg("PYRUHVRO_TPU_SERVE_WORKERS", "int", 2,
+     "Serving-plane worker threads draining the micro-batch queues.")
+_reg("PYRUHVRO_TPU_SERVE_MAX_BATCH_ROWS", "int", 32768,
+     "Row cap for one coalesced serving micro-batch (whole requests "
+     "only; a single larger request still runs alone).")
+_reg("PYRUHVRO_TPU_SERVE_COALESCE_S", "float", 0.002,
+     "Extra wait after the first dequeue for a micro-batch to form "
+     "(0 = dispatch whatever is already queued).")
+_reg("PYRUHVRO_TPU_SERVE_ENQUEUE_WAIT_S", "float", 1.0,
+     "Upper bound on how long the 'block' policy waits for queue "
+     "space (further bounded by the request's own deadline).")
+_reg("PYRUHVRO_TPU_SERVE_BATCH_TIMEOUT_S", "float", 30.0,
+     "Stall watchdog for one coalesced batch attempt: blowing it "
+     "while member requests still have budget trips the serve_worker "
+     "breaker and drains to the serial path.")
+_reg("PYRUHVRO_TPU_SERVE_TENANT_SHARE", "float", 0.5,
+     "Max fraction of total queued serving requests one tenant may "
+     "hold once the plane is more than half full (admission "
+     "fairness; <= 0 disables the cap).")
+_reg("PYRUHVRO_TPU_SERVE_BROWNOUT", "float", 0.7,
+     "Queue-pressure fraction (fullest queue) where the brownout "
+     "degradation ladder starts engaging rungs (> 1 disables).")
+_reg("PYRUHVRO_TPU_SERVE_BROWNOUT_SUSTAIN", "int", 3,
+     "Consecutive over-threshold pressure evaluations before a "
+     "brownout rung engages (hysteresis against blips).")
+
 
 # ---------------------------------------------------------------------------
 # accessors

@@ -302,6 +302,15 @@ class _SpaceSaving:
 _sketch = _SpaceSaving()
 
 
+def tenant_hotlist() -> List[Dict[str, Any]]:
+    """The heavy-hitter sketch rows (tenant, schema, calls, rows,
+    bytes; bytes-descending) WITHOUT running the cache probes that
+    ``snapshot_memory`` triggers — the serving plane's per-tenant
+    admission signal reads this on the submit path, so it must stay
+    cheap."""
+    return _sketch.snapshot()
+
+
 def _approx_bytes(payload) -> int:
     """Cheap input-size estimate for attribution: exact for pyarrow
     batches (``nbytes``) and arrow-ingested datum views (vectorized

@@ -20,7 +20,9 @@ telemetry registry per request:
 * ``GET /flight`` — the flight recorder ring (``telemetry.flight_dump()``);
 * ``GET /memory`` — the live memory accounting section
   (``memacct.snapshot_memory()``: RSS, per-cache footprints, lifecycle
-  state, per-tenant heavy hitters — ISSUE 12).
+  state, per-tenant heavy hitters — ISSUE 12);
+* ``GET /serve`` — the live serving-plane section (queues, pressure,
+  shed/brownout accounting — ISSUE 19); ``{}`` when no plane ran.
 
 Enable with ``PYRUHVRO_TPU_OBS_PORT=<port>`` (``0`` = any free port; the
 chosen port is logged and available as ``server().port``) — the server
@@ -39,6 +41,7 @@ from __future__ import annotations
 import gzip
 import json
 import os
+import sys
 import threading
 import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -135,6 +138,10 @@ def health() -> Tuple[int, Dict[str, Any]]:
         # "answers may be silently wrong", which outranks every
         # latency condition above
         "audit_mismatch": recent("audit_mismatch"),
+        # a serving-plane queue hit its depth cap within the window —
+        # the load balancer should stop preferring this replica even
+        # though it still answers (admission is shedding/blocking)
+        "queue_saturated": recent("queue_saturated"),
     }
     # non-closed circuit breakers are degradation facts: the process
     # still answers (the degraded path serves), so they stay 200, but a
@@ -142,15 +149,26 @@ def health() -> Tuple[int, Dict[str, Any]]:
     open_breakers = {name: b["state"]
                      for name, b in breaker.snapshot_breakers().items()
                      if b.get("state") != "closed"}
+    # the serving plane's brownout ladder: engaged rungs are live state
+    # (not window-based), read without importing the package eagerly
+    serving_mod = sys.modules.get("pyruhvro_tpu.serving")
+    brownout_rungs = (list(serving_mod.engaged_rungs())
+                      if serving_mod is not None else [])
     degraded = {
         "spawn_pool_broken": not process_available(),
         "native_ext": _native_state(),
         "device_backend": _device_state(),
         "breakers": open_breakers,
+        # serving plane shed at least one request within the window
+        "shedding": recent("serve_shed"),
+        # brownout rungs currently engaged (auto-recover on pressure
+        # release; each engagement is also counted)
+        "brownout": brownout_rungs,
     }
     ready = not any(unhealthy.values())
     status = ("ok" if ready and not degraded["spawn_pool_broken"]
-              and not open_breakers
+              and not open_breakers and not degraded["shedding"]
+              and not brownout_rungs
               else "degraded" if ready else "unhealthy")
     body: Dict[str, Any] = {
         "status": status,
@@ -260,6 +278,20 @@ class _Handler(BaseHTTPRequestHandler):
                     from . import audit
 
                     self._send_json(200, audit.snapshot_audit())
+            elif path == "/serve":
+                if snap_doc is not None:
+                    sv = snap_doc.get("serving")
+                    self._send_json(
+                        200, sv if sv is not None else {
+                            "static": True,
+                            "note": "snapshot predates the serving "
+                                    "plane, or no plane ran",
+                        })
+                else:
+                    serving_mod = sys.modules.get("pyruhvro_tpu.serving")
+                    self._send_json(
+                        200, serving_mod.snapshot_serving()
+                        if serving_mod is not None else {})
             elif path == "/memory":
                 if snap_doc is not None:
                     mem = snap_doc.get("memory")
@@ -277,7 +309,8 @@ class _Handler(BaseHTTPRequestHandler):
                 self._send_json(404, {
                     "error": f"unknown path {path!r}",
                     "endpoints": ["/metrics", "/healthz", "/snapshot",
-                                  "/flight", "/memory", "/audit"],
+                                  "/flight", "/memory", "/audit",
+                                  "/serve"],
                 })
         except BrokenPipeError:
             pass  # scraper went away mid-response
@@ -310,6 +343,7 @@ def _static_health(snap: Dict[str, Any]) -> Tuple[int, Dict[str, Any]]:
             "drift_detections": counters.get("drift.detected", 0),
             "slo_breaches": counters.get("slo.breach", 0),
             "audit_mismatches": counters.get("audit.mismatches", 0),
+            "serve_shed": counters.get("serve.shed", 0),
         },
     }
     if breached:
@@ -412,6 +446,6 @@ def start_from_env() -> Optional[ObsServer]:
     import sys
 
     print(f"[pyruhvro_tpu] obs server listening on {srv.url} "
-          "(/metrics /healthz /snapshot /flight /memory /audit)",
+          "(/metrics /healthz /snapshot /flight /memory /audit /serve)",
           file=sys.stderr)
     return srv

@@ -32,6 +32,7 @@ from . import knobs, metrics
 __all__ = [
     "QuarantinedRecord",
     "collecting",
+    "rebase",
     "extend_current",
     "last",
     "set_last",
@@ -77,6 +78,21 @@ class collecting:
         _tls.active = self._prev
         _tls.merged = self._prev_merged
         return False
+
+
+def rebase(entries, base: int) -> List[QuarantinedRecord]:
+    """Shift every entry's GLOBAL row index by ``base`` — the one
+    re-indexing rule shared by the spawn-pool merge (a worker's chunk
+    starts at its chunk offset in the caller's input) and the serving
+    plane's coalesced-batch split (a member request's rows start at its
+    offset in the coalesced input, so ``base`` is negative there to
+    recover the original caller's record indices). Accepts records or
+    raw worker tuples; always returns :class:`QuarantinedRecord`\\ s."""
+    out: List[QuarantinedRecord] = []
+    for e in entries:
+        t = tuple(e)
+        out.append(QuarantinedRecord(t[0] + base, *t[1:]))
+    return out
 
 
 def extend_current(entries) -> None:

@@ -270,6 +270,10 @@ _ROOT_OPS = {
     "api.deserialize_array": "decode",
     "api.deserialize_array_threaded": "decode",
     "api.serialize_record_batch": "encode",
+    # serving-plane end-to-end latency (enqueue -> resolution, so queue
+    # wait burns the same budget the caller's SLO measures); fed
+    # directly by serving._resolve, not by a root span
+    "serve.request": "serve",
 }
 
 

@@ -992,6 +992,13 @@ def snapshot() -> Dict[str, Any]:
     from . import memacct
 
     out["memory"] = memacct.snapshot_memory()
+    # serving plane (ISSUE 19): sys.modules guard so exporting never
+    # imports the package; omitted when no plane ever started
+    serving_mod = sys.modules.get("pyruhvro_tpu.serving")
+    if serving_mod is not None:
+        sv = serving_mod.snapshot_serving()
+        if sv:
+            out["serving"] = sv
     g = metrics.gauges()
     if g:
         out["gauges"] = g
@@ -1525,6 +1532,8 @@ def main(argv: Optional[List[str]] = None) -> int:
     different arm would have won) / ``slo-report <file>`` (objectives,
     burn rates, breach state) / ``mem-report <file>`` (memory
     accounting: RSS vs tracked footprints, evictions, heavy hitters) /
+    ``serve-report <file>`` (serving plane: admission, shed and
+    brownout accounting) /
     ``serve <file> [--port N]`` (serve a saved snapshot over HTTP) /
     ``fleet <snap...|--scrape host:port...>`` (merge N replicas'
     snapshots into one fleet snapshot) / ``diff <a> <b>`` (regression
@@ -1577,6 +1586,11 @@ def main(argv: Optional[List[str]] = None) -> int:
                            "footprints, eviction causes and per-tenant "
                            "heavy hitters from a snapshot JSON")
     p_mem.add_argument("path")
+    p_srvrep = sub.add_parser(
+        "serve-report", help="serving-plane report: admission/shed/"
+                             "brownout accounting, queue pressure and "
+                             "e2e latency from a snapshot JSON")
+    p_srvrep.add_argument("path")
     p_serve = sub.add_parser(
         "serve", help="serve a SAVED snapshot over HTTP (/metrics "
                       "/healthz /snapshot) — point dashboards at a "
@@ -1769,6 +1783,16 @@ def main(argv: Optional[List[str]] = None) -> int:
         from . import memacct
 
         sys.stdout.write(memacct.render_mem_report(data))
+    elif args.cmd == "serve-report":
+        if not ({"serving", "counters", "histograms"} & set(data)):
+            return _usage_error(
+                "not a telemetry snapshot (expected 'serving'/"
+                "'counters'/'histograms' keys)")
+        # legacy snapshots (no 'serving' section) degrade to a note
+        # inside the renderer, matching every other report subcommand
+        from ..serving import render_serve_report
+
+        sys.stdout.write(render_serve_report(data))
     elif args.cmd == "serve":
         if not ({"counters", "histograms", "spans"} & set(data)):
             return _usage_error(

@@ -10,6 +10,7 @@ Usage::
     python -m pyruhvro_tpu.telemetry what-if snapshot.json
     python -m pyruhvro_tpu.telemetry slo-report snapshot.json
     python -m pyruhvro_tpu.telemetry mem-report snapshot.json
+    python -m pyruhvro_tpu.telemetry serve-report snapshot.json
     python -m pyruhvro_tpu.telemetry serve snapshot.json --port 9464
     python -m pyruhvro_tpu.telemetry knobs [--markdown]
 

@@ -266,6 +266,7 @@ def main() -> int:
                 lambda: p.deserialize_array(data, KAFKA_SCHEMA_JSON,
                                             backend="host"),
                 check=lambda out: out.equals(ref))
+        ok &= _serve_leg(ledger)
 
     if not args.skip_pool:
         ok &= _pool_leg(ledger)
@@ -283,6 +284,48 @@ def main() -> int:
           f"-> {args.out}", flush=True)
     faulthandler.cancel_dump_traceback_later()
     return 0 if ok and not doc["failed"] else 1
+
+
+def _serve_leg(ledger) -> bool:
+    """Serving-plane cells (ISSUE 19): a crashing coalesced batch under
+    shed policy and a WEDGED one under block policy. Both must drain to
+    the per-request serial path with byte-identical output; the hang
+    must be bounded by the batch stall watchdog, not the members'
+    request budgets."""
+    import pyruhvro_tpu as p
+    from pyruhvro_tpu.runtime import breaker, faults
+    from pyruhvro_tpu.serving import ServePlane
+    from pyruhvro_tpu.utils.datagen import KAFKA_SCHEMA_JSON, \
+        kafka_style_datums
+
+    corpora = [kafka_style_datums(8, seed=40 + i) for i in range(3)]
+    refs = [p.deserialize_array(c, KAFKA_SCHEMA_JSON) for c in corpora]
+    ok = True
+    for kind, policy in (("error", "shed"), ("hang", "block")):
+        faults.reset()
+        breaker.reset()  # a tripped serve_worker from the error cell
+        os.environ["PYRUHVRO_TPU_SERVE_POLICY"] = policy
+        if kind == "hang":
+            os.environ["PYRUHVRO_TPU_SERVE_BATCH_TIMEOUT_S"] = "0.05"
+
+        def run_cell():
+            plane = ServePlane(autostart=False)
+            futs = [plane.submit("decode", c, KAFKA_SCHEMA_JSON,
+                                 timeout_s=30.0) for c in corpora]
+            plane.drain()
+            return [f.result(timeout=0) for f in futs]
+
+        try:
+            ok &= Cell(ledger, "serve_worker", kind, "serve_decode",
+                       policy, 30.0).run(
+                run_cell,
+                check=lambda out: all(b.equals(r) for b, r in
+                                      zip(out, refs)))
+        finally:
+            os.environ.pop("PYRUHVRO_TPU_SERVE_POLICY", None)
+            os.environ.pop("PYRUHVRO_TPU_SERVE_BATCH_TIMEOUT_S", None)
+    ok &= _recover("serve_worker")
+    return ok
 
 
 def _pool_leg(ledger) -> bool:

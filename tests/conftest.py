@@ -38,6 +38,11 @@ def _telemetry_isolation():
         # telemetry.reset() by design; tests still need a clean slate
         breaker.reset()
         faults.reset()
+        # the serving plane holds worker threads + process-wide brownout
+        # overrides; only touched when a test actually imported it
+        serving = sys.modules.get("pyruhvro_tpu.serving")
+        if serving is not None:
+            serving.reset()
 
     _reset()
     yield

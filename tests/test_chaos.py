@@ -1044,3 +1044,118 @@ def test_halfopen_process_probes_ride_the_explore_schedule(monkeypatch):
     assert picked.count("process") <= 2
     # ...but the explore tick did offer it (the probe path)
     assert "process" in picked
+
+
+# ---------------------------------------------------------------------------
+# serving plane (ISSUE 19): the serve_enqueue / serve_worker seams ×
+# backpressure policy, including the wedged-batch -> breaker -> serial
+# drain contract
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("policy", ["block", "shed"])
+def test_serve_worker_error_cell_serial_fallback_byte_identical(
+        chaos, monkeypatch, policy):
+    """error × {block,shed}: a crashing coalesced batch degrades to the
+    per-request serial path — byte-identical output, counted, and the
+    repeated failure opens the serve_worker breaker."""
+    from pyruhvro_tpu.serving import ServePlane
+
+    monkeypatch.setenv("PYRUHVRO_TPU_SERVE_POLICY", policy)
+    chaos("serve_worker:error:1")
+    for round_no in range(2):  # threshold 2: second round opens it
+        plane = ServePlane(autostart=False)
+        futs = [plane.submit(
+            "decode", kafka_style_datums(4, seed=60 + i),
+            KAFKA_SCHEMA_JSON, timeout_s=30.0) for i in range(3)]
+        plane.drain()
+        for i, f in enumerate(futs):
+            want = p.deserialize_array(
+                kafka_style_datums(4, seed=60 + i), KAFKA_SCHEMA_JSON)
+            assert f.result(timeout=0).equals(want)
+    c = metrics.snapshot()
+    assert c.get("fault.injected.serve_worker") == 2.0, c
+    assert c.get("serve.worker_degraded") == 2.0, c
+    assert breaker.get("serve_worker").state() == "open"
+
+
+@pytest.mark.parametrize("policy", ["block", "shed"])
+def test_serve_worker_hang_cell_watchdog_trips_breaker(
+        chaos, monkeypatch, policy):
+    """hang × {block,shed}: a WEDGED coalesced batch is bounded by the
+    batch stall watchdog, not by the member requests' (much larger)
+    budgets. The watchdog expiry while members still have budget is the
+    wedged-batch signature: breaker failure recorded, survivors drain
+    to the serial path, byte-identical."""
+    from pyruhvro_tpu.serving import ServePlane
+
+    monkeypatch.setenv("PYRUHVRO_TPU_SERVE_POLICY", policy)
+    monkeypatch.setenv("PYRUHVRO_TPU_SERVE_BATCH_TIMEOUT_S", "0.05")
+    chaos("serve_worker:hang:1", hang_s=0.3)
+    plane = ServePlane(autostart=False)
+    futs = [plane.submit(
+        "decode", kafka_style_datums(4, seed=70 + i),
+        KAFKA_SCHEMA_JSON, timeout_s=30.0) for i in range(3)]
+    t0 = time.perf_counter()
+    plane.drain()
+    dt = time.perf_counter() - t0
+    # every member still had ~30 s of budget: none may expire; all are
+    # served by the serial retry after the hang
+    for i, f in enumerate(futs):
+        want = p.deserialize_array(
+            kafka_style_datums(4, seed=70 + i), KAFKA_SCHEMA_JSON)
+        assert f.result(timeout=0).equals(want)
+    assert dt < 10.0  # hung once for 0.3 s, then bounded — not wedged
+    c = metrics.snapshot()
+    assert c.get("fault.injected.serve_worker") == 1.0, c
+    assert c.get("serve.worker_degraded") == 1.0, c
+    assert c.get("serve.expired") is None, c
+
+
+@pytest.mark.parametrize("policy", ["block", "shed"])
+def test_serve_enqueue_cell_direct_bypass(chaos, monkeypatch, policy):
+    """A degradable admission fault serves the call DIRECTLY (queue
+    bypassed), byte-identical under either policy."""
+    from pyruhvro_tpu.serving import ServePlane
+
+    monkeypatch.setenv("PYRUHVRO_TPU_SERVE_POLICY", policy)
+    chaos("serve_enqueue:error:1")
+    data = kafka_style_datums(6, seed=80)
+    want = p.deserialize_array(data, KAFKA_SCHEMA_JSON)
+    plane = ServePlane(autostart=False)
+    f = plane.submit("decode", data, KAFKA_SCHEMA_JSON, timeout_s=30.0)
+    assert f.result(timeout=0).equals(want)
+    plane.drain()
+    c = metrics.snapshot()
+    assert c.get("fault.injected.serve_enqueue") == 1.0, c
+    assert c.get("serve.enqueue_degraded") == 1.0, c
+
+
+def test_serve_breaker_reopens_coalescing_after_recovery(
+        chaos, monkeypatch):
+    """The ISSUE 8 half-open contract on the serving seam: after the
+    fault clears and the backoff elapses, the half-open probe re-admits
+    coalescing."""
+    from pyruhvro_tpu.serving import ServePlane
+
+    br = breaker.get("serve_worker")
+    br.force_open(backoff_s=0.02)
+    plane = ServePlane(autostart=False)
+    futs = [plane.submit(
+        "decode", kafka_style_datums(2, seed=90 + i),
+        KAFKA_SCHEMA_JSON, timeout_s=30.0) for i in range(2)]
+    plane.drain()  # open breaker -> serial, still correct
+    for f in futs:
+        assert f.result(timeout=0).num_rows == 2
+    assert metrics.snapshot().get("serve.breaker_serial") == 1.0
+    time.sleep(0.05)  # backoff elapses -> half-open
+    plane2 = ServePlane(autostart=False)
+    futs2 = [plane2.submit(
+        "decode", kafka_style_datums(2, seed=95 + i),
+        KAFKA_SCHEMA_JSON, timeout_s=30.0) for i in range(2)]
+    plane2.drain()
+    for f in futs2:
+        assert f.result(timeout=0).num_rows == 2
+    # the probe batch succeeded: the seam is closed again
+    assert breaker.get("serve_worker").state() == "closed"
+    assert metrics.snapshot().get("serve.coalesced") == 2.0

