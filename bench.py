@@ -625,6 +625,75 @@ def _measure_audit_overhead(schema, datums, chunks, details,
          f"on {on_s * 1e3:.3f} ms vs off {off_s * 1e3:.3f} ms per round)")
 
 
+def _measure_timeline_overhead(schema, datums, chunks, details,
+                               calls_per_round: int = 40,
+                               rounds: int = 4):
+    """Timeline-plane cost vs kill-switched on the kafka decode
+    (ISSUE 20 acceptance: sub-1%). The plane's per-call footprint is
+    zero by design — aggregation happens on the background tick thread
+    and events fire only at state transitions — so this probe measures
+    what the caller actually pays: the tick thread snapshotting the
+    registry concurrently with decode traffic. The interval is dropped
+    to 0.25s for the enabled blocks so ticks genuinely land inside the
+    measurement window (at the default 10s they never would), making
+    the measured fraction an over-estimate of production cost."""
+    from pyruhvro_tpu.api import deserialize_array_threaded
+    from pyruhvro_tpu.runtime import timeline
+
+    budget = 0.01
+    probe = datums[: min(len(datums), 1000)]
+
+    def block(n):
+        t0 = time.perf_counter()
+        for _ in range(n):
+            deserialize_array_threaded(probe, schema, chunks,
+                                       backend="host")
+        return time.perf_counter() - t0
+
+    env = os.environ
+    prev_kill = env.get("PYRUHVRO_TPU_NO_TIMELINE")
+    prev_iv = env.get("PYRUHVRO_TPU_TIMELINE_INTERVAL_S")
+    try:
+        env.pop("PYRUHVRO_TPU_NO_TIMELINE", None)
+        env["PYRUHVRO_TPU_TIMELINE_INTERVAL_S"] = "0.25"
+        timeline.ensure_started()
+        block(3)  # warmup (caches, specialization)
+        on_s = off_s = float("inf")
+        for _ in range(rounds):
+            env.pop("PYRUHVRO_TPU_NO_TIMELINE", None)
+            on_s = min(on_s, block(calls_per_round))
+            env["PYRUHVRO_TPU_NO_TIMELINE"] = "1"
+            off_s = min(off_s, block(calls_per_round))
+        env.pop("PYRUHVRO_TPU_NO_TIMELINE", None)
+        sec = timeline.snapshot_timeline()
+    finally:
+        if prev_kill is None:
+            env.pop("PYRUHVRO_TPU_NO_TIMELINE", None)
+        else:
+            env["PYRUHVRO_TPU_NO_TIMELINE"] = prev_kill
+        if prev_iv is None:
+            env.pop("PYRUHVRO_TPU_TIMELINE_INTERVAL_S", None)
+        else:
+            env["PYRUHVRO_TPU_TIMELINE_INTERVAL_S"] = prev_iv
+    frac = ((on_s - off_s) / off_s) if off_s > 0 else 0.0
+    details["timeline_overhead"] = {
+        "workload": (f"deserialize kafka {len(probe)} rows x{chunks} "
+                     f"[host] x{calls_per_round} calls/round"),
+        "enabled_s": round(on_s, 6),
+        "disabled_s": round(off_s, 6),
+        "overhead_frac": round(frac, 4),
+        "budget": budget,
+        "within_budget": frac <= budget + 0.005,  # noise floor
+        "ticks": len(sec.get("ticks") or []),
+        "events": len(sec.get("events") or []),
+        "probe_interval_s": 0.25,
+    }
+    _log(f"[bench] timeline overhead: {frac * 100:.2f}% "
+         f"(budget {budget * 100:.2f}%, {len(sec.get('ticks') or [])} "
+         f"tick(s) at 0.25s during the enabled blocks; "
+         f"on {on_s * 1e3:.3f} ms vs off {off_s * 1e3:.3f} ms per round)")
+
+
 def _measure_otlp_overhead(schema, datums, chunks, details,
                            calls_per_round: int = 20,
                            rounds: int = 4):
@@ -859,6 +928,13 @@ def main() -> None:
         _measure_audit_overhead(kafka, datums, args.chunks, details)
     except Exception as e:
         _log(f"[bench] audit overhead measurement failed: {e!r}")
+
+    # timeline-plane overhead (ISSUE 20 acceptance: the aggregation
+    # tick thread vs kill-switched on the kafka decode stays sub-1%)
+    try:
+        _measure_timeline_overhead(kafka, datums, args.chunks, details)
+    except Exception as e:
+        _log(f"[bench] timeline overhead measurement failed: {e!r}")
 
     def _headline_line():
         if headline is None:

@@ -378,6 +378,12 @@ def _incident(m: AuditMismatch) -> None:
     metrics.inc("audit.mismatch." + m.column)
     metrics.inc("audit.mismatches")
     metrics.mark("audit_mismatch")  # the live /healthz bit
+    from . import timeline
+
+    timeline.event("audit.mismatch", severity="incident",
+                   attrs={"schema": m.schema, "arm": m.arm,
+                          "column": m.column, "row": m.row_index},
+                   trace_id=m.trace_id)
     with _lock:
         _mismatch_ring.append(m._asdict())
     telemetry.annotate(audit_mismatch=m.column, audit_arm=m.arm)

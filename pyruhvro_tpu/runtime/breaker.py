@@ -44,7 +44,7 @@ import threading
 import time
 from typing import Any, Dict, Optional
 
-from . import knobs, metrics, schedtest
+from . import knobs, metrics, schedtest, timeline
 
 __all__ = [
     "CircuitBreaker",
@@ -120,6 +120,8 @@ class CircuitBreaker:
             self._probe_at = None
             self._probe_owner = None
             metrics.inc(f"breaker.{self.name}.half_open")
+            timeline.event("breaker.half_open",
+                           attrs={"breaker": self.name})
         if (self._state == "half_open" and self._probe_at is not None
                 and now - self._probe_at > _PROBE_TTL_S):
             # forfeited probe: allow another (the forfeiter's eventual
@@ -179,6 +181,8 @@ class CircuitBreaker:
                 self._state = "closed"
                 self._opens = 0
                 metrics.inc(f"breaker.{self.name}.closed")
+                timeline.event("breaker.closed",
+                               attrs={"breaker": self.name})
 
     def record_failure(self) -> None:
         """A call through the seam failed. In half-open (failed probe)
@@ -198,6 +202,10 @@ class CircuitBreaker:
                 self._open_until = now + self._next_backoff_s()
                 metrics.inc(f"breaker.{self.name}.opened")
                 metrics.mark("breaker_open")
+                timeline.event("breaker.opened", severity="warn",
+                               attrs={"breaker": self.name,
+                                      "failures": self._failures,
+                                      "opens": self._opens})
 
     def release(self) -> None:
         """Return an acquired half-open probe slot WITHOUT a verdict:
@@ -230,6 +238,9 @@ class CircuitBreaker:
             self._probe_owner = None
             metrics.inc(f"breaker.{self.name}.opened")
             metrics.mark("breaker_open")
+            timeline.event("breaker.opened", severity="warn",
+                           attrs={"breaker": self.name, "forced": True,
+                                  "opens": self._opens})
 
     def export(self) -> Dict[str, Any]:
         with self._lock:

@@ -102,6 +102,14 @@ def _evict_one(c: _Managed, key, cause: str) -> bool:
         return False
     if ok:
         metrics.inc(f"cache.evict.{c.name}.{cause}")
+        # pressure-relief evictions are operationally interesting (the
+        # evictor is eating caches to save the process); TTL/capacity
+        # churn is routine and would flood the event ring
+        if cause == "pressure":
+            from . import timeline
+
+            timeline.event("cache.evict", severity="warn",
+                           attrs={"cache": c.name, "cause": cause})
     return ok
 
 

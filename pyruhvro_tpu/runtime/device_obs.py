@@ -197,6 +197,10 @@ def note_compile(fingerprint: str, kind: str, bucket: str, seconds: float,
     if storm:
         metrics.inc("device.recompile_storm")
         metrics.mark("recompile_storm")  # the live /healthz bit
+        from . import timeline
+
+        timeline.event("device.recompile_storm", severity="incident",
+                       attrs={"schema": fingerprint})
         telemetry.annotate(recompile_storm=True)
         telemetry._flight_autodump("recompile_storm")
         # a storming schema's device arms are withheld from the router

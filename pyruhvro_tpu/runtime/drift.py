@@ -94,8 +94,11 @@ def observe(schema: str, op: str, band: int, arm: str,
         return
     metrics.inc("drift.detected")
     metrics.mark("latency_drift")
-    from . import costmodel, telemetry
+    from . import costmodel, telemetry, timeline
 
+    timeline.event("drift.detected", severity="incident",
+                   attrs={"schema": schema, "arm": arm,
+                          "factor": round(factor, 3)})
     telemetry.annotate(drift_arm=arm)
     telemetry._flight_autodump("drift")
     costmodel.penalize_arm(schema, arm, _PENALTY_WINDOW_S,

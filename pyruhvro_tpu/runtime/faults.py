@@ -92,6 +92,7 @@ SITES = (
     "serve_worker",     # serving: coalesced micro-batch execution seam
                         # (degrades to the per-request serial path)
     "serve_flight",     # serving/flight.py: Arrow Flight handler seam
+    "incident_capture",  # incident.capture_now: bundle write seam
 )
 
 _KINDS = ("error", "hang", "exit")

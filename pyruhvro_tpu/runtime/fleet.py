@@ -32,7 +32,7 @@ from typing import Any, Dict, List, Optional, Tuple
 from . import metrics
 
 __all__ = ["fetch_snapshot", "merge_snapshots", "diff_snapshots",
-           "render_diff"]
+           "render_diff", "parse_window", "window_snapshot"]
 
 _FETCH_TIMEOUT_S = 10.0
 _MAX_SPANS = 64  # same retention as telemetry's live ring
@@ -343,6 +343,59 @@ def _merge_audit(snaps: List[Dict[str, Any]],
     return out
 
 
+def _merge_timeline(snaps: List[Dict[str, Any]],
+                    tags: List[str]) -> Dict[str, Any]:
+    """Fold per-replica ``timeline`` sections onto ONE clock. Replica
+    wall clocks skew; every timeline record carries the PR 15 ts/mono
+    pair and the section's export stamps its own ``now_ts``/``now_mono``,
+    so each record's true age is ``now_mono - mono`` (drift-free) and
+    its fleet-aligned wall time is ``ref_now - age`` against the newest
+    replica's clock. Ticks and events get ``replica`` tags and merge
+    into one chronologically-sorted stream — 'which replica tripped
+    first' becomes a question the rendering answers directly."""
+    sections = [(s.get("timeline"), tag) for s, tag in zip(snaps, tags)
+                if isinstance(s.get("timeline"), dict)
+                and s.get("timeline")]
+    if not sections:
+        return {}
+    ref_now = max(float(sec.get("now_ts") or 0.0) for sec, _ in sections)
+    ticks: List[Dict[str, Any]] = []
+    events: List[Dict[str, Any]] = []
+    dropped = 0
+    for sec, tag in sections:
+        now_ts = float(sec.get("now_ts") or 0.0)
+        now_mono = sec.get("now_mono")
+        offset = ref_now - now_ts  # wall-clock skew fallback
+        dropped += int(sec.get("events_dropped") or 0)
+
+        def align(rec: Dict[str, Any]) -> Dict[str, Any]:
+            rec = dict(rec)
+            rec["replica"] = tag
+            mono = rec.get("mono")
+            if now_mono is not None and mono is not None:
+                age = float(now_mono) - float(mono)
+                rec["ts"] = round(ref_now - age, 6)
+            elif rec.get("ts") is not None:
+                rec["ts"] = round(float(rec["ts"]) + offset, 6)
+            return rec
+
+        ticks += [align(t) for t in sec.get("ticks") or []]
+        events += [align(e) for e in sec.get("events") or []]
+    ticks.sort(key=lambda r: float(r.get("ts") or 0.0))
+    events.sort(key=lambda r: float(r.get("ts") or 0.0))
+    return {
+        "interval_s": min(float(sec.get("interval_s") or 10.0)
+                          for sec, _ in sections),
+        "retention": max(int(sec.get("retention") or 1)
+                         for sec, _ in sections),
+        "now_ts": ref_now,
+        "ticks": ticks,
+        "events": events,
+        "events_dropped": dropped,
+        "fleet": True,
+    }
+
+
 def _merge_breakers(snaps: List[Dict[str, Any]],
                     tags: List[str]) -> Dict[str, Any]:
     out: Dict[str, Any] = {}
@@ -398,6 +451,9 @@ def merge_snapshots(snaps: List[Dict[str, Any]],
     brs = _merge_breakers(snaps, tags)
     if brs:
         out["breakers"] = brs
+    tl = _merge_timeline(snaps, tags)
+    if tl:
+        out["timeline"] = tl
     aud = _merge_audit(snaps, tags)
     if aud:
         out["audit"] = aud
@@ -408,6 +464,119 @@ def merge_snapshots(snaps: List[Dict[str, Any]],
             out["counters"]["audit.fleet_divergent"] = (
                 out["counters"].get("audit.fleet_divergent", 0.0)
                 + float(len(aud["divergent"])))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# timeline windows (diff --window)
+# ---------------------------------------------------------------------------
+
+
+def parse_window(spec: str) -> Tuple[Optional[float], Optional[float]]:
+    """Parse ``A..B`` into raw window bounds. Each side is a number or
+    empty (unbounded); numbers >= 1e9 are absolute epoch seconds, >= 0
+    are seconds forward from a snapshot's FIRST tick, < 0 are seconds
+    back from its NEWEST tick — resolved per snapshot by
+    :func:`window_snapshot`. Raises ValueError on malformed specs (the
+    CLI maps it onto the exit-2 contract)."""
+    if ".." not in spec:
+        raise ValueError(
+            f"--window wants A..B (got {spec!r}); bounds are epoch "
+            "seconds, seconds from the first tick, or negative seconds "
+            "back from the newest tick")
+    lo_s, _, hi_s = spec.partition("..")
+
+    def num(s: str) -> Optional[float]:
+        s = s.strip()
+        if not s:
+            return None
+        try:
+            return float(s)
+        except ValueError:
+            raise ValueError(f"--window bound {s!r} is not a number")
+
+    return num(lo_s), num(hi_s)
+
+
+def _resolve_bound(v: Optional[float], first_ts: float,
+                   last_ts: float) -> Optional[float]:
+    if v is None:
+        return None
+    if v >= 1e9:  # no timeline predates 2001; smaller means relative
+        return v
+    if v < 0:
+        return last_ts + v
+    return first_ts + v
+
+
+def _slice_summary(sl: Dict[str, Any]) -> Dict[str, Any]:
+    """A tick's histogram slice (NON-cumulative buckets) re-shaped as a
+    summary :func:`_merge_hist` accepts (cumulative buckets)."""
+    buckets: List[list] = []
+    cum = 0
+    for le, c in sl.get("buckets") or []:
+        cum += int(c)
+        buckets.append([le, cum])
+    return {"count": sl.get("count", 0), "sum": sl.get("sum", 0.0),
+            "buckets": buckets}
+
+
+def window_snapshot(snap: Dict[str, Any],
+                    window: Tuple[Optional[float], Optional[float]],
+                    ) -> Optional[Dict[str, Any]]:
+    """Reconstruct a snapshot covering ONLY the timeline ticks inside
+    ``window``: counters are the sum of in-window deltas, gauges the
+    last in-window tick's values, histograms the merge of in-window
+    delta slices (quantiles recomputed). Returns None when the snapshot
+    has no timeline ticks (legacy, or the plane was off) — callers
+    degrade to whole-snapshot attribution."""
+    sec = snap.get("timeline")
+    if not isinstance(sec, dict) or not sec.get("ticks"):
+        return None
+    ticks = sec["ticks"]
+    first_ts = float(ticks[0].get("ts") or 0.0)
+    last_ts = float(ticks[-1].get("ts") or 0.0)
+    lo = _resolve_bound(window[0], first_ts, last_ts)
+    hi = _resolve_bound(window[1], first_ts, last_ts)
+    sel = [t for t in ticks
+           if (lo is None or float(t.get("ts") or 0.0) >= lo)
+           and (hi is None or float(t.get("ts") or 0.0) <= hi)]
+    counters: Dict[str, float] = {}
+    slices: Dict[str, List[Dict[str, Any]]] = {}
+    gauges: Dict[str, float] = {}
+    for t in sel:
+        for k, v in (t.get("counters") or {}).items():
+            counters[k] = counters.get(k, 0.0) + float(v)
+        for k, sl in (t.get("histograms") or {}).items():
+            slices.setdefault(k, []).append(_slice_summary(sl))
+        if t.get("gauges"):
+            gauges = {k: float(v) for k, v in t["gauges"].items()}
+    evs = [e for e in sec.get("events") or []
+           if (lo is None or float(e.get("ts") or 0.0) >= lo)
+           and (hi is None or float(e.get("ts") or 0.0) <= hi)]
+    out: Dict[str, Any] = {
+        "schema_version": snap.get("schema_version"),
+        "pid": snap.get("pid"),
+        "counters": counters,
+        "histograms": {k: _merge_hist(v)
+                       for k, v in sorted(slices.items())},
+        "spans": [],
+        "spans_dropped": 0,
+        "windowed": {
+            "from": lo, "to": hi, "ticks": len(sel),
+            "of_ticks": len(ticks),
+        },
+        "timeline": {
+            "interval_s": sec.get("interval_s"),
+            "retention": sec.get("retention"),
+            "now_ts": sec.get("now_ts"),
+            "ticks": sel,
+            "events": evs,
+            "events_dropped": sec.get("events_dropped", 0),
+        },
+    }
+    if gauges:
+        out["gauges"] = gauges
     return out
 
 

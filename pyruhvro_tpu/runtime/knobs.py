@@ -237,6 +237,24 @@ _reg("PYRUHVRO_TPU_NO_AUDIT", "bool", False,
 _reg("PYRUHVRO_TPU_CAPACITY_PERSIST", "bool", False,
      "Persist learned device-capacity plans into ROUTING_PROFILE even "
      "without autotune.")
+_reg("PYRUHVRO_TPU_TIMELINE_INTERVAL_S", "float", 10.0,
+     "Timeline aggregation-tick interval in seconds: each tick stores "
+     "per-interval counter deltas, gauge values and histogram bucket "
+     "deltas (floored at 0.05s).")
+_reg("PYRUHVRO_TPU_TIMELINE_RETENTION", "int", 360,
+     "Timeline ring depth in ticks (default 360 x 10s = one hour of "
+     "history, bounded memory).")
+_reg("PYRUHVRO_TPU_INCIDENT_DIR", "str", "",
+     "Directory for auto-captured incident bundles (one atomic JSON "
+     "per incident event, debounced + rotation-bounded); empty "
+     "disables capture.")
+_reg("PYRUHVRO_TPU_INCIDENT_MAX_FILES", "int", 16,
+     "Incident-bundle retention cap: oldest auto-shaped bundles past "
+     "this count are deleted on capture (0 = unlimited; hand-saved "
+     "files are never touched).")
+_reg("PYRUHVRO_TPU_NO_TIMELINE", "bool", False,
+     "Kill switch for the incident timeline plane (tick thread, event "
+     "stream and incident auto-capture).")
 
 # ---- memory accounting / cache lifecycle ----------------------------------
 _reg("PYRUHVRO_TPU_MEM_HIGH_WATER", "int", 0,

@@ -374,6 +374,10 @@ def tick() -> None:
         return
     metrics.inc("mem.pressure")
     metrics.mark("mem_pressure")
+    from . import timeline
+
+    timeline.event("mem.pressure", severity="incident",
+                   attrs={"rss_bytes": rss, "high_water_bytes": hw})
     evicted, freed = cachelife.relieve(rss - hw)
     metrics.inc("mem.pressure_evicted", evicted)
     from . import telemetry

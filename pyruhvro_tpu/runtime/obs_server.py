@@ -292,6 +292,39 @@ class _Handler(BaseHTTPRequestHandler):
                     self._send_json(
                         200, serving_mod.snapshot_serving()
                         if serving_mod is not None else {})
+            elif path == "/timeline":
+                if snap_doc is not None:
+                    tl = snap_doc.get("timeline")
+                    self._send_json(
+                        200, tl if tl is not None else {
+                            "static": True,
+                            "note": "snapshot predates the incident "
+                                    "timeline plane, or it never "
+                                    "ticked",
+                        })
+                else:
+                    from . import timeline
+
+                    if flag("tick"):
+                        # ?tick=1: force an aggregation tick NOW so an
+                        # operator mid-incident sees the current
+                        # interval without waiting out the clock
+                        timeline.tick_now()
+                    self._send_json(200, timeline.snapshot_timeline())
+            elif path == "/incidents":
+                if snap_doc is not None:
+                    self._send_json(200, {
+                        "static": True,
+                        "incidents": [],
+                        "note": "incident bundles are on-disk "
+                                "artifacts, not part of saved "
+                                "snapshots; use the live endpoint or "
+                                "list PYRUHVRO_TPU_INCIDENT_DIR",
+                    })
+                else:
+                    from . import incident
+
+                    self._send_json(200, incident.list_incidents())
             elif path == "/memory":
                 if snap_doc is not None:
                     mem = snap_doc.get("memory")
@@ -310,7 +343,7 @@ class _Handler(BaseHTTPRequestHandler):
                     "error": f"unknown path {path!r}",
                     "endpoints": ["/metrics", "/healthz", "/snapshot",
                                   "/flight", "/memory", "/audit",
-                                  "/serve"],
+                                  "/serve", "/timeline", "/incidents"],
                 })
         except BrokenPipeError:
             pass  # scraper went away mid-response
@@ -446,6 +479,7 @@ def start_from_env() -> Optional[ObsServer]:
     import sys
 
     print(f"[pyruhvro_tpu] obs server listening on {srv.url} "
-          "(/metrics /healthz /snapshot /flight /memory /audit /serve)",
+          "(/metrics /healthz /snapshot /flight /memory /audit /serve "
+          "/timeline /incidents)",
           file=sys.stderr)
     return srv

@@ -174,4 +174,9 @@ def publish(entries: List[QuarantinedRecord], policy: str,
         # metric-key: <op>.quarantine_storms
         metrics.inc(op + ".quarantine_storms")
         metrics.mark("quarantine_storm")  # the live /healthz bit
+        from . import timeline
+
+        timeline.event("quarantine.storm", severity="incident",
+                       attrs={"op": op, "entries": len(entries),
+                              "policy": policy})
         telemetry._flight_autodump("quarantine")

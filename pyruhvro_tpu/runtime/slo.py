@@ -357,6 +357,10 @@ def _sweep() -> None:
 def _fire_transitions(fired: List[tuple], recovered: int) -> None:
     if recovered:
         metrics.inc("slo.recovered", float(recovered))
+        from . import timeline
+
+        timeline.event("slo.recovered",
+                       attrs={"objectives": recovered})
     for o, stats in fired:
         _on_breach(o, stats)
 
@@ -367,8 +371,12 @@ def _on_breach(o: _Objective, stats: List[Dict[str, Any]]) -> None:
     # from a recency mark like the storm bits
     metrics.inc("slo.breach")
     metrics.inc(f"slo.breach.{o.name}")
-    from . import telemetry
+    from . import telemetry, timeline
 
+    timeline.event("slo.breach", severity="incident",
+                   attrs={"objective": o.name,
+                          "burn_rate": (stats[0].get("burn_rate")
+                                        if stats else None)})
     telemetry.annotate(slo_breach=o.name)
     telemetry._flight_autodump("slo_breach")
     if o.alert_command:

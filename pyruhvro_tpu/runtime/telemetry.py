@@ -716,6 +716,14 @@ if knobs.get_raw("PYRUHVRO_TPU_OBS_PORT"):
 
     _obs_server.start_from_env()
 
+# incident timeline plane (ISSUE 20): the aggregation tick thread is
+# default-on (one registry copy per 10s interval) so every process gets
+# time-bucketed history without code change; PYRUHVRO_TPU_NO_TIMELINE
+# keeps it parked
+from . import timeline as _timeline
+
+_timeline.ensure_started()
+
 # memory accounting (ISSUE 12): the span/flight rings are themselves
 # long-lived state — account them like every other ring (per-record
 # size is an explicit estimate; the rings are bounded by construction)
@@ -906,6 +914,10 @@ def reset() -> None:
     audit.reset()
     slo.reset()
     memacct.reset()
+    from . import incident, timeline
+
+    timeline.reset()
+    incident.reset()
     # NOT breaker/faults: breaker state is OPERATIONAL (an open breaker
     # must survive a snapshot reset — wiping it would silently re-admit
     # a broken seam) and the fault-injection counters are the chaos
@@ -999,6 +1011,13 @@ def snapshot() -> Dict[str, Any]:
         sv = serving_mod.snapshot_serving()
         if sv:
             out["serving"] = sv
+    # incident timeline plane (ISSUE 20): time-bucketed history +
+    # correlated events; omitted until the first tick or event
+    from . import timeline
+
+    tl = timeline.snapshot_timeline()
+    if tl:
+        out["timeline"] = tl
     g = metrics.gauges()
     if g:
         out["gauges"] = g
@@ -1420,6 +1439,15 @@ def render_report(data: Dict[str, Any]) -> str:
                 f"{(oov.get('budget') or 0) * 100:.2f}% -> "
                 f"{'ok' if oov.get('within_budget') else 'OVER BUDGET'}"
             )
+        tov = data.get("timeline_overhead")
+        if tov:
+            out.append(
+                f"timeline-tick overhead on {tov.get('workload', '?')}: "
+                f"{tov.get('overhead_frac', 0) * 100:.2f}% vs budget "
+                f"{(tov.get('budget') or 0) * 100:.2f}% "
+                f"({tov.get('ticks')} tick(s)) -> "
+                f"{'ok' if tov.get('within_budget') else 'OVER BUDGET'}"
+            )
     else:  # telemetry snapshot
         counters = data.get("counters", {})
         hists = data.get("histograms", {})
@@ -1639,6 +1667,27 @@ def main(argv: Optional[List[str]] = None) -> int:
     p_diff.add_argument("--json", action="store_true",
                         help="emit the structured diff document "
                              "instead of the text report")
+    p_diff.add_argument("--window", metavar="A..B",
+                        help="diff only the timeline window A..B of "
+                             "each snapshot: bounds are epoch seconds "
+                             "(>= 1e9), seconds from the first tick "
+                             "(>= 0), or seconds back from the newest "
+                             "tick (< 0); either side may be empty")
+    p_tl = sub.add_parser(
+        "timeline", help="time-bucketed history from a snapshot JSON: "
+                         "per-interval counter deltas and histogram "
+                         "quantiles with state-transition events "
+                         "interleaved at their position in time")
+    p_tl.add_argument("path")
+    p_tl.add_argument("--json", action="store_true",
+                      help="emit the raw timeline section instead of "
+                           "the text rendering")
+    p_inc = sub.add_parser(
+        "incident-report", help="post-mortem rendering of an "
+                                "auto-captured incident bundle (also "
+                                "accepts a plain snapshot: renders its "
+                                "timeline section)")
+    p_inc.add_argument("path")
     args = ap.parse_args(argv)
 
     if args.cmd == "knobs":
@@ -1723,6 +1772,23 @@ def main(argv: Optional[List[str]] = None) -> int:
         b = _load_snapshot(args.b)
         if isinstance(b, int):
             return b
+        if args.window:
+            try:
+                win = _fleet.parse_window(args.window)
+            except ValueError as e:
+                return _usage_error(str(e))
+            for name, path, doc in (("a", args.a, a), ("b", args.b, b)):
+                w = _fleet.window_snapshot(doc, win)
+                if w is None:
+                    # degradation, not failure: attribution still runs
+                    # on the whole snapshot, just without the window
+                    print(f"note: {path} has no timeline ticks — "
+                          "diffing the whole snapshot for side "
+                          f"'{name}'", file=sys.stderr)
+                elif name == "a":
+                    a = w
+                else:
+                    b = w
         if args.json:
             json.dump(_fleet.diff_snapshots(a, b), sys.stdout,
                       indent=1, default=str)
@@ -1783,6 +1849,31 @@ def main(argv: Optional[List[str]] = None) -> int:
         from . import memacct
 
         sys.stdout.write(memacct.render_mem_report(data))
+    elif args.cmd == "timeline":
+        if not ({"timeline", "counters", "histograms"} & set(data)):
+            return _usage_error(
+                "not a telemetry snapshot (expected 'timeline'/"
+                "'counters'/'histograms' keys)")
+        # legacy snapshots (no 'timeline' section) degrade to a note
+        # inside the renderer, matching every other report subcommand
+        from . import timeline as _tl
+
+        if args.json:
+            json.dump(data.get("timeline") or {}, sys.stdout, indent=1,
+                      default=str)
+            sys.stdout.write("\n")
+        else:
+            sys.stdout.write(_tl.render_timeline(data))
+    elif args.cmd == "incident-report":
+        if not ({"timeline", "trigger", "counters", "histograms"}
+                & set(data)):
+            return _usage_error(
+                "not an incident bundle or telemetry snapshot "
+                "(expected 'trigger'/'timeline'/'counters'/"
+                "'histograms' keys)")
+        from . import incident as _incident
+
+        sys.stdout.write(_incident.render_incident_report(data))
     elif args.cmd == "serve-report":
         if not ({"serving", "counters", "histograms"} & set(data)):
             return _usage_error(

@@ -79,6 +79,7 @@ from ..runtime import (
 from ..runtime import audit as _audit
 from ..runtime import quarantine as _quarantine
 from ..runtime import sampling as _sampling
+from ..runtime import timeline
 
 __all__ = [
     "Overloaded",
@@ -241,6 +242,8 @@ class _Brownout:
         # metric-key: serve.brownout.<rung>
         metrics.inc("serve.brownout." + rung)
         metrics.mark("serve_brownout")  # the /healthz degraded bit
+        timeline.event("serve.brownout", severity="warn",
+                       attrs={"rung": rung})
         if rung == "audit":
             _audit.set_enabled(False)
         elif rung == "sampling":
@@ -254,6 +257,7 @@ class _Brownout:
         if t0 is not None:
             self._occupancy[rung] += now - t0
         metrics.inc("serve.brownout_release." + rung)  # metric-key: serve.brownout_release.<rung>
+        timeline.event("serve.brownout_release", attrs={"rung": rung})
         if rung == "audit":
             _audit.set_enabled(None)
         elif rung == "sampling":
@@ -300,6 +304,10 @@ class ServePlane:
         # (op, fp) -> EWMA seconds/row from completed work: the drain
         # estimator's fallback when the cost model has no observation
         self._spr: Dict[tuple, float] = {}
+        # per-name re-arm stamps for onset timeline events: shedding /
+        # saturation fire per REQUEST, but the timeline wants the
+        # episode boundary, not a per-call flood of the event ring
+        self._evt_mono: Dict[str, float] = {}
         self._started_at = time.time()
         if autostart:
             self.start_workers()
@@ -307,6 +315,19 @@ class ServePlane:
     # ------------------------------------------------------------------
     # knobs (read per call so tests can flip them in-process)
     # ------------------------------------------------------------------
+
+    _EVENT_REARM_S = 5.0
+
+    def _onset_event(self, name: str, severity: str,
+                     attrs: Dict[str, Any]) -> None:
+        """Publish a timeline event for a per-request condition at most
+        once per :data:`_EVENT_REARM_S` — the timeline wants the
+        episode onset, not one event per shed request."""
+        now = time.monotonic()
+        if now - self._evt_mono.get(name, -1e9) < self._EVENT_REARM_S:
+            return
+        self._evt_mono[name] = now
+        timeline.event(name, severity=severity, attrs=attrs)
 
     @staticmethod
     def _depth() -> int:
@@ -376,6 +397,9 @@ class ServePlane:
                 metrics.inc("serve.shed." + reason)
                 metrics.inc("serve.shed")
                 metrics.mark("serve_shed")  # /healthz degraded bit
+                self._onset_event("serve.shed", "warn",
+                                  {"reason": reason, "tenant": tenant,
+                                   "queued": self._queued_total})
                 raise Overloaded(
                     f"request shed at admission ({reason})",
                     reason=reason, tenant=tenant,
@@ -421,6 +445,9 @@ class ServePlane:
         q = self._queues.get(key)
         if q is not None and len(q) >= self._depth():
             metrics.mark("queue_saturated")  # /healthz unhealthy bit
+            self._onset_event("serve.queue_saturated", "incident",
+                              {"depth": len(q),
+                               "queued": self._queued_total})
             return "queue_full"
         return None
 

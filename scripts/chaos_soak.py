@@ -267,6 +267,7 @@ def main() -> int:
                                             backend="host"),
                 check=lambda out: out.equals(ref))
         ok &= _serve_leg(ledger)
+        ok &= _incident_leg(ledger)
 
     if not args.skip_pool:
         ok &= _pool_leg(ledger)
@@ -325,6 +326,52 @@ def _serve_leg(ledger) -> bool:
             os.environ.pop("PYRUHVRO_TPU_SERVE_POLICY", None)
             os.environ.pop("PYRUHVRO_TPU_SERVE_BATCH_TIMEOUT_S", None)
     ok &= _recover("serve_worker")
+    return ok
+
+
+def _incident_leg(ledger) -> bool:
+    """Incident-bundle write seam (ISSUE 20): an injected error during
+    the bundle write degrades to a counted ``incident.capture_failed``
+    and the live decode alongside is untouched; a hang is bounded by
+    the soak's FAULT_HANG_S and the (delayed) capture still lands."""
+    import tempfile
+
+    import pyruhvro_tpu as p
+    from pyruhvro_tpu.runtime import metrics
+    from pyruhvro_tpu.utils.datagen import KAFKA_SCHEMA_JSON, \
+        kafka_style_datums
+
+    data = kafka_style_datums(64, seed=41)
+    ref = p.deserialize_array(data, KAFKA_SCHEMA_JSON, backend="host")
+    ok = True
+    with tempfile.TemporaryDirectory() as d:
+        os.environ["PYRUHVRO_TPU_INCIDENT_DIR"] = d
+        try:
+            for kind in ("error", "hang"):
+
+                def run_cell():
+                    from pyruhvro_tpu.runtime import incident
+
+                    path = incident.capture_now("chaos_soak")
+                    out = p.deserialize_array(data, KAFKA_SCHEMA_JSON,
+                                              backend="host")
+                    return path, out
+
+                def check(pair, k=kind):
+                    path, out = pair
+                    if not out.equals(ref):  # the live call, unaffected
+                        return False
+                    if k == "error":
+                        return (path is None and metrics.snapshot().get(
+                            "incident.capture_failed", 0) >= 1)
+                    return path is not None and os.path.exists(path)
+
+                ok &= Cell(ledger, "incident_capture", kind,
+                           "incident_bundle", "-",
+                           2.0 if kind == "hang" else None).run(
+                    run_cell, check=check)
+        finally:
+            os.environ.pop("PYRUHVRO_TPU_INCIDENT_DIR", None)
     return ok
 
 

@@ -218,8 +218,11 @@ def save_snapshot(path: str) -> None:
     """The run's full telemetry snapshot — including the ``device``
     jit-cache/memory section when the device tier ran — as the gate's
     evidence artifact (CI exports it as a Perfetto trace too)."""
-    from pyruhvro_tpu.runtime import fsio, telemetry
+    from pyruhvro_tpu.runtime import fsio, telemetry, timeline
 
+    # close out the current aggregation interval so the artifact's
+    # timeline section covers the run's final stretch (ISSUE 20)
+    timeline.tick_now()
     fsio.atomic_write_json(path, telemetry.snapshot())
     _log(f"[perf-gate] telemetry snapshot -> {path}")
 

@@ -523,6 +523,51 @@ def test_flight_dump_fault_never_fails_the_observed_call(
     assert list(tmp_path.glob("*.json")) == []  # nothing half-written
 
 
+def test_incident_capture_fault_degrades_to_counted_failure(
+        chaos, tmp_path, monkeypatch):
+    """ISSUE 20 matrix cell: an injected error during the incident
+    bundle write counts ``incident.capture_failed``, leaves no
+    half-written file, and the live decode alongside is untouched."""
+    from pyruhvro_tpu.runtime import incident
+
+    monkeypatch.setenv("PYRUHVRO_TPU_INCIDENT_DIR", str(tmp_path))
+    data = kafka_style_datums(40, seed=3)
+    ref = p.deserialize_array(data, KAFKA_SCHEMA_JSON, backend="host")
+    chaos("incident_capture:error:1")
+    assert incident.capture_now("chaos_test") is None
+    out = p.deserialize_array(data, KAFKA_SCHEMA_JSON, backend="host")
+    assert out.equals(ref)  # the live call, unaffected
+    c = metrics.snapshot()
+    assert c.get("fault.injected.incident_capture", 0) >= 1, c
+    assert c.get("incident.capture_failed", 0) >= 1, c
+    assert not c.get("incident.captured"), c
+    assert list(tmp_path.glob("incident_*.json")) == []
+    chaos("")
+    # the seam heals: the next capture lands a complete bundle
+    path = incident.capture_now("chaos_test")
+    assert path is not None and os.path.exists(path)
+
+
+def test_incident_capture_hang_is_bounded_and_still_lands(
+        chaos, tmp_path, monkeypatch):
+    """Hang kind: the injected stall is FAULT_HANG_S-bounded (off the
+    hot path — only the capturing thread waits) and the bundle still
+    lands complete after the stall."""
+    from pyruhvro_tpu.runtime import incident
+
+    monkeypatch.setenv("PYRUHVRO_TPU_INCIDENT_DIR", str(tmp_path))
+    monkeypatch.setenv("PYRUHVRO_TPU_FAULT_HANG_S", "0.2")
+    chaos("incident_capture:hang:1")
+    t0 = time.monotonic()
+    path = incident.capture_now("chaos_hang")
+    dt = time.monotonic() - t0
+    assert path is not None and os.path.exists(path)
+    assert 0.2 <= dt < 5.0, dt
+    with open(path, encoding="utf-8") as f:
+        doc = json.load(f)
+    assert doc["kind"] == "incident" and doc["trigger"] == "chaos_hang"
+
+
 def test_obs_handler_fault_500s_but_server_survives(chaos):
     srv = obs_server.ObsServer(port=0).start()
     try:

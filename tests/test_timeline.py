@@ -1,0 +1,522 @@
+"""Incident timeline plane (ISSUE 20).
+
+Covers the aggregation-ring math (counter deltas re-sum to the
+cumulative registry, per-interval histogram quantiles), retention and
+event-ring bounds, tick/event correlation ordering in the renderer,
+incident-bundle debounce + rotation (hand-saved files survive), the
+end-to-end incident drill (quarantine storm -> /healthz 503 -> exactly
+one debounced bundle -> rendered breach interval), the clock-skew-
+aligned fleet merge, `diff --window` reconstruction, and tick-vs-decode
+thread safety under schedtest seeds.
+"""
+
+import json
+import os
+import time
+
+import pytest
+
+import pyruhvro_tpu as p
+from pyruhvro_tpu.runtime import (
+    fleet,
+    incident,
+    metrics,
+    obs_server,
+    schedtest,
+    telemetry,
+    timeline,
+)
+from pyruhvro_tpu.utils.datagen import KAFKA_SCHEMA_JSON, kafka_style_datums
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+LEGACY_SNAPSHOT = os.path.join(
+    REPO, "tests", "data", "telemetry_snapshot_sample.json")
+
+
+def _sweep_seeds():
+    return range(int(os.environ.get("PYRUHVRO_TPU_SCHED_SEEDS", 8)))
+
+
+# ---------------------------------------------------------------------------
+# aggregation-ring math
+# ---------------------------------------------------------------------------
+
+
+def test_counter_deltas_resum_to_cumulative():
+    metrics.inc("tlq.alpha", 5.0)
+    t1 = timeline.tick_now()
+    assert t1["counters"]["tlq.alpha"] == 5.0
+    # the very first tick has no previous boundary to measure from
+    assert t1["dur_s"] is None
+    metrics.inc("tlq.alpha", 7.0)
+    metrics.inc("tlq.beta", 2.0)
+    t2 = timeline.tick_now()
+    assert t2["counters"]["tlq.alpha"] == 7.0
+    assert t2["counters"]["tlq.beta"] == 2.0
+    assert t2["dur_s"] is not None and t2["dur_s"] >= 0.0
+    # an idle interval stores NO delta for the key (sparse ticks)
+    t3 = timeline.tick_now()
+    assert "tlq.alpha" not in t3["counters"]
+    ticks = timeline.snapshot_timeline()["ticks"]
+    total = sum(t["counters"].get("tlq.alpha", 0.0) for t in ticks)
+    assert total == metrics.snapshot()["tlq.alpha"] == 12.0
+
+
+def test_histogram_interval_quantiles_recomputed_per_tick():
+    for _ in range(20):
+        telemetry.observe("tlq.fast_s", 0.001)
+    t1 = timeline.tick_now()
+    h1 = t1["histograms"]["tlq.fast_s"]
+    assert h1["count"] == 20
+    for _ in range(20):
+        telemetry.observe("tlq.fast_s", 0.5)
+    t2 = timeline.tick_now()
+    h2 = t2["histograms"]["tlq.fast_s"]
+    # the second interval's distribution is 20 slow samples ONLY: its
+    # p50 must sit in a slow bucket even though the cumulative
+    # histogram is now a 50/50 mix
+    assert h2["count"] == 20
+    assert h2["p50"] > h1["p50"]
+    assert h2["p50"] >= 0.5
+    # delta buckets are NON-cumulative and re-sum to the interval count
+    assert sum(c for _, c in h2["buckets"]) == 20
+    # sums are per-interval too
+    assert h2["sum"] == pytest.approx(20 * 0.5, rel=1e-6)
+    # an idle interval stores no histogram slice at all
+    t3 = timeline.tick_now()
+    assert "tlq.fast_s" not in (t3.get("histograms") or {})
+
+
+def test_retention_keeps_only_newest_ticks(monkeypatch):
+    monkeypatch.setenv("PYRUHVRO_TPU_TIMELINE_RETENTION", "5")
+    stamps = []
+    for i in range(9):
+        metrics.inc("tlq.tickmark")
+        stamps.append(timeline.tick_now()["ts"])
+    sec = timeline.snapshot_timeline()
+    assert len(sec["ticks"]) == 5
+    assert [t["ts"] for t in sec["ticks"]] == stamps[-5:]
+    assert sec["retention"] == 5
+
+
+def test_event_ring_bounds_and_drop_accounting():
+    for i in range(timeline.EVENT_RING + 50):
+        timeline.event("tlq.spam", attrs={"i": i})
+    sec = timeline.snapshot_timeline()
+    assert len(sec["events"]) == timeline.EVENT_RING
+    assert sec["events_dropped"] == 50
+    # oldest dropped, newest kept
+    assert sec["events"][-1]["attrs"]["i"] == timeline.EVENT_RING + 49
+    assert sec["events"][0]["attrs"]["i"] == 50
+    assert "dropped" in timeline.render_timeline(sec).splitlines()[0]
+
+
+def test_event_severity_degrades_and_kill_switch(monkeypatch):
+    rec = timeline.event("tlq.odd", severity="catastrophic")
+    assert rec["severity"] == "info"
+    monkeypatch.setenv("PYRUHVRO_TPU_NO_TIMELINE", "1")
+    assert timeline.event("tlq.gone") is None
+    assert timeline.tick_now() is None
+    assert timeline.ensure_started() is False
+
+
+def test_snapshot_section_omitted_until_first_record():
+    assert "timeline" not in telemetry.snapshot()
+    timeline.event("tlq.first")
+    sec = telemetry.snapshot()["timeline"]
+    assert [e["name"] for e in sec["events"]] == ["tlq.first"]
+    # ts/mono pairing is the fleet-alignment contract
+    assert set(sec) >= {"now_ts", "now_mono", "interval_s", "retention"}
+    assert "mono" in sec["events"][0]
+
+
+def test_render_interleaves_events_between_ticks():
+    metrics.inc("tlq.one")
+    timeline.tick_now()
+    timeline.event("tlq.mid", severity="warn", attrs={"z": 1})
+    metrics.inc("tlq.two")
+    timeline.tick_now()
+    text = timeline.render_timeline(telemetry.snapshot())
+    lines = [ln for ln in text.splitlines() if ln]
+    rows = [ln for ln in lines if ln[0].isdigit() or ln.startswith("    ")]
+    assert len(rows) == 3
+    assert "tlq.one" in rows[0]
+    assert "[warn" in rows[1] and "tlq.mid" in rows[1] and "z=1" in rows[1]
+    assert "tlq.two" in rows[2]
+
+
+def test_render_degrades_on_legacy_snapshot():
+    with open(LEGACY_SNAPSHOT) as f:
+        legacy = json.load(f)
+    assert "no timeline section" in timeline.render_timeline(legacy)
+
+
+# ---------------------------------------------------------------------------
+# incident bundles: debounce, rotation, section isolation
+# ---------------------------------------------------------------------------
+
+
+def test_bundle_debounce_coalesces_a_storm(tmp_path, monkeypatch):
+    monkeypatch.setenv("PYRUHVRO_TPU_INCIDENT_DIR", str(tmp_path))
+    assert incident.request("first") is True
+    # a second trigger while one is pending coalesces
+    assert incident.request("second") is False
+    path = incident.maybe_capture()
+    assert path is not None and os.path.exists(path)
+    assert "first" in os.path.basename(path)
+    # the debounce window is armed: new requests are suppressed
+    assert incident.request("third") is False
+    assert incident.maybe_capture() is None
+    assert list(tmp_path.glob("incident_*.json")) == [
+        type(tmp_path)(path)]
+    snap = metrics.snapshot()
+    assert snap["incident.captured"] == 1.0
+    assert snap["incident.debounced"] == 2.0
+
+
+def test_bundle_requests_noop_without_dir():
+    assert incident.request("nowhere") is False
+    assert incident.maybe_capture() is None
+    assert incident.capture_now("nowhere") is None
+    assert "incident.requested" not in metrics.snapshot()
+
+
+def test_rotation_spares_hand_saved_files(tmp_path, monkeypatch):
+    monkeypatch.setenv("PYRUHVRO_TPU_INCIDENT_DIR", str(tmp_path))
+    monkeypatch.setenv("PYRUHVRO_TPU_INCIDENT_MAX_FILES", "3")
+    keeper = tmp_path / "incident_keep.json"  # not auto-shaped
+    keeper.write_text("{}")
+    notes = tmp_path / "postmortem-notes.json"
+    notes.write_text("{}")
+    paths = []
+    for i in range(6):
+        path = incident.capture_now(f"trig{i}")
+        assert path is not None
+        paths.append(path)
+        os.utime(path, (i, i))  # deterministic mtime order
+    names = sorted(n for n in os.listdir(tmp_path)
+                   if incident._NAME_RE.match(n))
+    assert len(names) == 3
+    # the newest three survive, the oldest three rotated out
+    assert names == sorted(os.path.basename(p) for p in paths[-3:])
+    assert keeper.exists() and notes.exists()
+    assert metrics.snapshot()["incident.dropped"] == 3.0
+
+
+def test_bundle_sections_fault_isolated(tmp_path, monkeypatch):
+    monkeypatch.setenv("PYRUHVRO_TPU_INCIDENT_DIR", str(tmp_path))
+
+    def boom():
+        raise RuntimeError("flight plane down")
+
+    monkeypatch.setattr(telemetry, "flight_dump", boom)
+    metrics.inc("tlq.evidence")
+    path = incident.capture_now("partial")
+    with open(path) as f:
+        doc = json.load(f)
+    assert "flight" not in doc
+    assert "RuntimeError" in doc["section_errors"]["flight"]
+    # the broken plane cost nothing else
+    assert doc["counters"]["tlq.evidence"] == 1.0
+    assert doc["kind"] == "incident"
+    assert metrics.snapshot()["incident.section_error"] >= 1.0
+
+
+def test_bundle_carries_the_post_mortem_evidence(tmp_path, monkeypatch):
+    monkeypatch.setenv("PYRUHVRO_TPU_INCIDENT_DIR", str(tmp_path))
+    monkeypatch.setenv("PYRUHVRO_TPU_TIMELINE_INTERVAL_S", "60")
+    p.deserialize_array(kafka_style_datums(16, seed=2), KAFKA_SCHEMA_JSON)
+    timeline.tick_now()
+    timeline.event("tlq.blow", severity="warn")
+    path = incident.capture_now("evidence", attrs={"why": "test"})
+    with open(path) as f:
+        doc = json.load(f)
+    assert doc["trigger"] == "evidence" and doc["attrs"] == {"why": "test"}
+    assert doc["timeline"]["ticks"] and doc["timeline"]["events"]
+    assert "code" in doc["health"]
+    assert "records" in doc["flight"]
+    assert isinstance(doc["breakers"], dict)
+    assert doc["knobs"].get("PYRUHVRO_TPU_INCIDENT_DIR") == str(tmp_path)
+    listing = incident.list_incidents()
+    assert listing["dir"] == str(tmp_path)
+    assert [e["file"] for e in listing["incidents"]] == [
+        os.path.basename(path)]
+    assert listing["incidents"][0]["trigger"] == "evidence"
+    assert listing["incidents"][0]["pid"] == os.getpid()
+
+
+# ---------------------------------------------------------------------------
+# the end-to-end incident drill
+# ---------------------------------------------------------------------------
+
+
+def test_incident_drill_storm_to_rendered_report(tmp_path, monkeypatch,
+                                                 capsys):
+    """The ISSUE 20 acceptance drill: a quarantine storm flips
+    /healthz, exactly ONE debounced bundle lands, and the CLI renders
+    the breach interval with the correlated storm event."""
+    monkeypatch.setenv("PYRUHVRO_TPU_INCIDENT_DIR", str(tmp_path))
+    monkeypatch.setenv("PYRUHVRO_TPU_QUARANTINE_STORM", "2")
+    datums = kafka_style_datums(24, seed=5)
+    bad = [d[:2] for d in datums[:4]]  # truncated -> quarantined
+    # two storms back to back: the second must debounce
+    for _ in range(2):
+        p.deserialize_array(bad, KAFKA_SCHEMA_JSON, backend="host",
+                            on_error="skip")
+    code, body = obs_server.health()
+    assert code == 503
+    assert body["unhealthy_bits"]["quarantine_storm"] is True
+    # the capture runs on the timeline thread (woken by the event);
+    # drain synchronously too, then give the racer a moment
+    incident.maybe_capture()
+    deadline = time.monotonic() + 10.0
+    while time.monotonic() < deadline and \
+            not list(tmp_path.glob("incident_*.json")):
+        time.sleep(0.02)
+    time.sleep(0.2)
+    bundles = sorted(tmp_path.glob("incident_*.json"))
+    assert len(bundles) == 1, [b.name for b in bundles]
+    with open(bundles[0]) as f:
+        doc = json.load(f)
+    assert doc["trigger"] == "quarantine.storm"
+    evs = [e for e in doc["timeline"]["events"]
+           if e["name"] == "quarantine.storm"]
+    assert evs and evs[0]["severity"] == "incident"
+    assert telemetry.main(["incident-report", str(bundles[0])]) == 0
+    out = capsys.readouterr().out
+    assert "breach interval" in out
+    assert "quarantine.storm" in out
+    assert "503" in out
+    assert metrics.snapshot()["incident.debounced"] >= 1.0
+
+
+# ---------------------------------------------------------------------------
+# fleet merge: skewed clocks, replica tags
+# ---------------------------------------------------------------------------
+
+
+def _replica_snapshot(now_ts, now_mono, event_ages, tick_age):
+    """A synthetic replica snapshot whose timeline records are placed
+    by AGE (now_mono - mono) — the drift-free signal the merge must
+    prefer over the replica's (skewed) wall clock."""
+    return {
+        "schema_version": 3,
+        "counters": {"calls": 1.0},
+        "histograms": {},
+        "spans": [],
+        "timeline": {
+            "interval_s": 10.0,
+            "retention": 360,
+            "now_ts": now_ts,
+            "now_mono": now_mono,
+            "ticks": [{
+                "ts": now_ts - tick_age,
+                "mono": now_mono - tick_age,
+                "dur_s": 10.0,
+                "counters": {"calls": 1.0},
+            }],
+            "events": [
+                {"ts": now_ts - age, "mono": now_mono - age,
+                 "name": name, "severity": "warn"}
+                for name, age in event_ages
+            ],
+            "events_dropped": 0,
+        },
+    }
+
+
+def test_fleet_merge_aligns_skewed_replica_clocks():
+    base = 1_700_000_000.0
+    # three replicas: wall clocks skewed by minutes, but the true
+    # event order by age is c (8s ago), a (5s ago), b (2s ago)
+    snaps = [
+        _replica_snapshot(base, 1000.0, [("ev.a", 5.0)], 12.0),
+        _replica_snapshot(base + 300.0, 5000.0, [("ev.b", 2.0)], 12.0),
+        _replica_snapshot(base - 300.0, 9000.0, [("ev.c", 8.0)], 12.0),
+    ]
+    merged = fleet.merge_snapshots(snaps, tags=["ra", "rb", "rc"])
+    tl = merged["timeline"]
+    assert tl["fleet"] is True
+    assert [e["name"] for e in tl["events"]] == ["ev.c", "ev.a", "ev.b"]
+    assert [e["replica"] for e in tl["events"]] == ["rc", "ra", "rb"]
+    # fleet-aligned timestamps live on the NEWEST replica's clock
+    ref = tl["now_ts"]
+    assert ref == base + 300.0
+    assert tl["events"][0]["ts"] == pytest.approx(ref - 8.0, abs=1e-3)
+    assert tl["events"][-1]["ts"] == pytest.approx(ref - 2.0, abs=1e-3)
+    assert len(tl["ticks"]) == 3
+    assert all(t["replica"] in ("ra", "rb", "rc") for t in tl["ticks"])
+    text = timeline.render_timeline(merged)
+    assert ", fleet) ==" in text.splitlines()[0]
+    assert "@rc" in text and "@ra" in text and "@rb" in text
+
+
+def test_three_live_replica_sections_merge_replica_tagged():
+    """Same assembly through REAL per-replica sections: serialize this
+    process's timeline three times with artificial skews."""
+    metrics.inc("tlq.live")
+    timeline.tick_now()
+    timeline.event("tlq.live_ev", severity="warn")
+    sec = telemetry.snapshot()["timeline"]
+    snaps = []
+    for skew in (0.0, 120.0, -45.0):
+        s = json.loads(json.dumps(sec))
+        s["now_ts"] += skew
+        for rec in s["ticks"] + s["events"]:
+            rec["ts"] += skew
+        snaps.append({"schema_version": 3, "counters": {},
+                      "histograms": {}, "spans": [], "timeline": s})
+    merged = fleet.merge_snapshots(snaps)
+    tl = merged["timeline"]
+    # identical mono ages -> identical aligned timestamps, skew gone
+    ev_ts = {e["ts"] for e in tl["events"]}
+    assert len(ev_ts) == 1
+    assert {e["replica"] for e in tl["events"]} == {"r0", "r1", "r2"}
+
+
+# ---------------------------------------------------------------------------
+# diff --window
+# ---------------------------------------------------------------------------
+
+
+def _windowed_snap():
+    base = 1_700_000_000.0
+    ticks = []
+    for i, delta in enumerate([1.0, 2.0, 4.0]):
+        ticks.append({
+            "ts": base + 10.0 * i, "mono": 100.0 + 10.0 * i,
+            "dur_s": 10.0,
+            "counters": {"k": delta},
+            "histograms": {"h_s": {
+                "count": int(delta), "sum": delta * 0.01,
+                "p50": 0.01, "p95": 0.01, "p99": 0.01,
+                "buckets": [[0.01, int(delta)]],
+            }},
+            "gauges": {"g": delta},
+        })
+    return {
+        "schema_version": 3, "pid": 1, "counters": {"k": 7.0},
+        "histograms": {}, "spans": [],
+        "timeline": {
+            "interval_s": 10.0, "retention": 360,
+            "now_ts": base + 25.0, "now_mono": 125.0,
+            "ticks": ticks,
+            "events": [{"ts": base + 11.0, "mono": 111.0,
+                        "name": "w.ev", "severity": "info"}],
+            "events_dropped": 0,
+        },
+    }
+
+
+def test_window_snapshot_reconstructs_in_window_registry():
+    snap = _windowed_snap()
+    w = fleet.window_snapshot(snap, fleet.parse_window("0..15"))
+    assert w["counters"]["k"] == 3.0  # ticks at +0 and +10 only
+    assert w["windowed"] == {"from": snap["timeline"]["ticks"][0]["ts"],
+                             "to": snap["timeline"]["ticks"][0]["ts"] + 15,
+                             "ticks": 2, "of_ticks": 3}
+    assert w["histograms"]["h_s"]["count"] == 3
+    assert w["gauges"]["g"] == 2.0  # last in-window tick's gauge
+    assert [e["name"] for e in w["timeline"]["events"]] == ["w.ev"]
+    # negative bounds anchor at the newest tick
+    w2 = fleet.window_snapshot(snap, fleet.parse_window("-15.."))
+    assert w2["counters"]["k"] == 6.0
+    assert w2["windowed"]["ticks"] == 2
+    # absolute epoch bounds pass through unresolved
+    lo = snap["timeline"]["ticks"][1]["ts"]
+    w3 = fleet.window_snapshot(snap, (lo, None))
+    assert w3["counters"]["k"] == 6.0
+
+
+def test_window_parse_and_legacy_contracts():
+    with pytest.raises(ValueError):
+        fleet.parse_window("15")
+    with pytest.raises(ValueError):
+        fleet.parse_window("a..b")
+    assert fleet.parse_window("..") == (None, None)
+    assert fleet.parse_window("-30..") == (-30.0, None)
+    # legacy snapshots have no ticks to window
+    assert fleet.window_snapshot({"counters": {}}, (None, None)) is None
+
+
+def test_cli_diff_window_and_exit_contracts(tmp_path, capsys):
+    a = tmp_path / "a.json"
+    b = tmp_path / "b.json"
+    a.write_text(json.dumps(_windowed_snap()))
+    grown = _windowed_snap()
+    grown["timeline"]["ticks"][1]["counters"]["k"] = 9.0
+    grown["counters"]["k"] = 14.0
+    b.write_text(json.dumps(grown))
+    assert telemetry.main(["diff", "--window", "0..15",
+                           str(a), str(b)]) == 0
+    out = capsys.readouterr().out
+    assert "counter deltas" in out
+    # malformed window spec -> the usual exit-2 usage contract
+    assert telemetry.main(["diff", "--window", "nope",
+                           str(a), str(b)]) == 2
+    # windowing a legacy snapshot degrades with a note, not an error
+    leg = tmp_path / "leg.json"
+    leg.write_text(json.dumps({"counters": {"k": 1.0},
+                               "histograms": {}, "spans": []}))
+    assert telemetry.main(["diff", "--window", "0..15",
+                           str(leg), str(a)]) == 0
+    assert "no timeline ticks" in capsys.readouterr().err
+
+
+def test_cli_timeline_and_incident_report_contracts(tmp_path, capsys):
+    metrics.inc("tlq.cli")
+    timeline.tick_now()
+    timeline.event("tlq.cli_ev")
+    snap = tmp_path / "snap.json"
+    snap.write_text(json.dumps(telemetry.snapshot(), default=str))
+    assert telemetry.main(["timeline", str(snap)]) == 0
+    assert "== timeline" in capsys.readouterr().out
+    assert telemetry.main(["timeline", str(snap), "--json"]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["ticks"]
+    # legacy degrades (exit 0), garbage/missing exit 2
+    assert telemetry.main(["timeline", LEGACY_SNAPSHOT]) == 0
+    assert "no timeline section" in capsys.readouterr().out
+    assert telemetry.main(["incident-report", LEGACY_SNAPSHOT]) == 0
+    assert "not an incident bundle" in capsys.readouterr().out
+    assert telemetry.main(["timeline",
+                           str(tmp_path / "missing.json")]) == 2
+    bad = tmp_path / "bad.json"
+    bad.write_text("{not json")
+    assert telemetry.main(["incident-report", str(bad)]) == 2
+
+
+# ---------------------------------------------------------------------------
+# thread safety: ticks vs concurrent production
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("seed", _sweep_seeds())
+def test_tick_vs_concurrent_production_never_loses_deltas(seed):
+    """Under every explored interleaving of the tick boundary against
+    live counter/event production, the per-interval deltas re-sum to
+    the cumulative registry — no delta is lost or double-counted."""
+
+    def produce():
+        for i in range(4):
+            metrics.inc("tlq.race")
+            timeline.event("tlq.race_ev", attrs={"i": i})
+
+    def ticker():
+        for _ in range(3):
+            timeline.tick_now()
+
+    h = schedtest.Harness(seed=seed)
+    h.thread(produce, name="producer")
+    h.thread(ticker, name="ticker")
+    h.run()
+    assert h.stalls == 0
+    timeline.tick_now()  # close out whatever the race left unticked
+    sec = timeline.snapshot_timeline()
+    total = sum(t["counters"].get("tlq.race", 0.0) for t in sec["ticks"])
+    assert total == metrics.snapshot()["tlq.race"] == 4.0
+    assert len([e for e in sec["events"]
+                if e["name"] == "tlq.race_ev"]) == 4
+    # monotone tick ordering survives the race
+    monos = [t["mono"] for t in sec["ticks"]]
+    assert monos == sorted(monos)
